@@ -1,0 +1,32 @@
+"""TPU-native multi-agent RL formation-control framework.
+
+A brand-new JAX/XLA framework with the capabilities of
+asanati/MARL-DistributedFormation (reference mounted at /root/reference):
+decentralized 2D formation control where each agent acts on local
+observations (itself, its two ring neighbors, the goal) under
+neighbor-shared rewards, trained with an in-repo PPO.
+
+Design: functional core, imperative shell.
+
+- ``env``      — pure-functional formation environment (jit+vmap over formations)
+- ``models``   — policy/value networks (MLP, GNN) in flax
+- ``algo``     — PPO: GAE via ``lax.scan``, clipped surrogate, minibatch epochs
+- ``parallel`` — device-mesh sharding (dp over formations, ring halo exchange
+                 over the agent axis via ``shard_map`` + ``ppermute``)
+- ``train``    — jitted end-to-end trainer, checkpointing, metrics
+- ``ops``      — Pallas TPU kernels and fused ops
+- ``compat``   — reference-workflow-compatible host-side adapters/frontends
+
+Reference layer map and parity contract: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from marl_distributedformation_tpu.env import (  # noqa: F401
+    EnvParams,
+    FormationState,
+    Transition,
+    reset,
+    step,
+    make_vec_env,
+)

@@ -1,0 +1,24 @@
+"""Pure-functional formation environment (see ``formation.py``)."""
+
+from marl_distributedformation_tpu.env.types import (  # noqa: F401
+    EnvParams,
+    FormationState,
+    Transition,
+    tree_select,
+)
+from marl_distributedformation_tpu.env.formation import (  # noqa: F401
+    compute_metrics,
+    compute_obs,
+    compute_reward,
+    make_vec_env,
+    reset,
+    reset_batch,
+    step,
+    step_batch,
+)
+from marl_distributedformation_tpu.env.spaces import (  # noqa: F401
+    Box,
+    action_space,
+    observation_space,
+)
+from marl_distributedformation_tpu.env.baseline import control  # noqa: F401

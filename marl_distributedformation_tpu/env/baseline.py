@@ -1,0 +1,89 @@
+"""Hand-crafted potential-field baseline controller, as a pure function.
+
+Reimplements the reference's scripted ``control`` (simulate.py:256-319): a
+spring force toward the desired spacing with both ring neighbors, a spring
+toward the diametrically-opposite agent (diameter spacing), obstacle
+repulsion, and goal attraction. It is the non-learned baseline used for
+return-parity testing (BASELINE.json config 1).
+
+Deviations from the reference, on purpose:
+- distances are clamped to ``eps`` before normalizing directions (the
+  reference divides by raw norms and would NaN on coincident agents);
+- odd ``num_agents`` is supported by rolling ``N // 2`` positions (the
+  reference asserts even N — SURVEY.md Q11); for even N this is identical.
+Like the reference (Q11), the controller uses its own ``desired_radius=40``,
+not the env reward's 60 — baseline and learned policy optimize different
+formation sizes, and the parity gate compares against this exact controller.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from marl_distributedformation_tpu.env.types import EnvParams
+
+Array = jax.Array
+
+CONTROL_DESIRED_RADIUS = 40.0  # reference simulate.py:259
+FORMATION_GAIN = 0.02  # simulate.py:290-292
+OBSTACLE_GAIN = 0.3  # simulate.py:304
+GOAL_GAIN = 0.01  # simulate.py:315
+
+
+def _unit(vec: Array, eps: float = 1e-8) -> tuple[Array, Array]:
+    dist = jnp.linalg.norm(vec, axis=-1)
+    return vec / jnp.maximum(dist, eps)[..., None], dist
+
+
+def control(
+    agents: Array, goal: Array, obstacles: Array, params: EnvParams
+) -> Array:
+    """Per-agent velocity command ``(N, 2)`` for the formation controller.
+
+    Pure function of positions — drive it through ``env.step`` with raw
+    velocities (the L0 contract), exactly like the reference's
+    ``control(i, env)`` does via ``env.step(f_formation + f_obstacle +
+    f_goal)`` (simulate.py:319).
+    """
+    num_agents = agents.shape[0]
+
+    # Ring neighbors (simulate.py:262-275): shift A = next, shift B = prev.
+    shift_a = jnp.roll(agents, -1, axis=0)
+    shift_b = jnp.roll(agents, 1, axis=0)
+    dir_a, dist_a = _unit(shift_a - agents)
+    dir_b, dist_b = _unit(shift_b - agents)
+
+    # Diametrically opposite agent (simulate.py:278-284).
+    opposite = jnp.roll(agents, num_agents // 2, axis=0)
+    dir_opp, dist_opp = _unit(opposite - agents)
+
+    desired_dist = np.pi * CONTROL_DESIRED_RADIUS / num_agents  # simulate.py:286
+
+    f_formation = (
+        FORMATION_GAIN * (dist_a - desired_dist)[:, None] * dir_a
+        + FORMATION_GAIN * (dist_b - desired_dist)[:, None] * dir_b
+        + FORMATION_GAIN
+        * (dist_opp - 2.0 * CONTROL_DESIRED_RADIUS)[:, None]
+        * dir_opp
+    )
+    f_formation = jnp.clip(f_formation, -1.0, 1.0)  # simulate.py:293
+
+    # Obstacle repulsion (simulate.py:296-307), vectorized over obstacles.
+    if obstacles.shape[0] > 0:
+        offsets = agents[None, :, :] - obstacles[:, None, :]  # (K, N, 2)
+        dists = jnp.linalg.norm(offsets, axis=-1)
+        dirs = offsets / jnp.maximum(dists, 1e-8)[..., None]
+        avoid_dist = params.obstacle_size * 2.0
+        repel = jnp.maximum(-OBSTACLE_GAIN * (dists - avoid_dist), 0.0)
+        f_obstacle = (repel[..., None] * dirs).sum(axis=0)
+    else:
+        f_obstacle = jnp.zeros_like(f_formation)
+
+    # Goal attraction toward the controller's own radius (simulate.py:309-317).
+    goal_dir, goal_dist = _unit(agents - goal)
+    f_goal = -(GOAL_GAIN * (goal_dist - CONTROL_DESIRED_RADIUS))[:, None] * goal_dir
+    f_goal = jnp.clip(f_goal, -1.0, 1.0)
+
+    return f_formation + f_obstacle + f_goal

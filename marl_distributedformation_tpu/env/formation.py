@@ -1,0 +1,327 @@
+"""Pure-functional formation-control environment.
+
+Reimplements the semantics of the reference's ``FormationSimulator``
+(``simulate.py:7-254``) as pure functions over a ``FormationState`` pytree:
+
+- physics: single-integrator ``agents += velocity`` with clipping to the
+  world box and an out-of-bounds flag (reference simulate.py:80-90);
+- observations: per-agent local view — own normalized position, offsets to
+  the two ring neighbors, optional normalized relative goal (simulate.py:150-174);
+- rewards: goal shaping + proximity bonus + asymmetric neighbor-spacing
+  penalty + boundary/obstacle penalties, then ring-neighbor reward mixing
+  (simulate.py:176-229);
+- auto-reset inside ``step`` following the SB3 VecEnv convention — the
+  observation returned on ``done`` is the first observation of the next
+  episode while the reward is the terminal reward (simulate.py:113-118).
+
+Every Python-level loop in the reference (per-agent observation loop
+simulate.py:162-167, reward-sharing loop simulate.py:223-229, per-formation
+loop vectorized_env.py:71-81) becomes a ``jnp.roll``/``vmap`` so the whole
+step compiles to one fused XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from marl_distributedformation_tpu.env.types import (
+    EnvParams,
+    FormationState,
+    Transition,
+    tree_select,
+)
+
+Array = jax.Array
+
+
+def reset(key: Array, params: EnvParams) -> FormationState:
+    """Sample a fresh formation state.
+
+    Matches the reference's initial-state distribution (simulate.py:120-147):
+    agents uniform over the bottom ``agent_spawn_band`` strip, goal uniform
+    with a ``desired_radius`` wall margin, obstacles uniform over the middle
+    band. The reference draws from torch's unseeded global RNG (SURVEY.md
+    Q9); here every formation carries its own PRNG stream, so rollouts are
+    reproducible — distributions match, exact draws intentionally don't.
+    """
+    key, k_obstacles, k_agents, k_goal = jax.random.split(key, 4)
+
+    # Obstacles: x in [s, W-s], y in [band+s, H-band-s] (simulate.py:125-127).
+    obstacles = jax.random.uniform(
+        k_obstacles, (params.num_obstacles, 2), dtype=jnp.float32
+    )
+    obstacles = obstacles * jnp.array(
+        [
+            params.width - 2.0 * params.obstacle_size,
+            params.height
+            - 2.0 * params.obstacle_margin_band
+            - 2.0 * params.obstacle_size,
+        ],
+        dtype=jnp.float32,
+    ) + jnp.array(
+        [
+            params.obstacle_size,
+            params.obstacle_margin_band + params.obstacle_size,
+        ],
+        dtype=jnp.float32,
+    )
+
+    # Agents: x in [0, W], y in [0, band] (simulate.py:133-135).
+    agents = jax.random.uniform(
+        k_agents, (params.num_agents, 2), dtype=jnp.float32
+    ) * jnp.array(
+        [params.width, params.agent_spawn_band], dtype=jnp.float32
+    )
+
+    # Goal: uniform with desired_radius margin from every wall
+    # (simulate.py:140-143).
+    goal = jax.random.uniform(k_goal, (2,), dtype=jnp.float32) * jnp.array(
+        [
+            params.width - 2.0 * params.desired_radius,
+            params.height - 2.0 * params.desired_radius,
+        ],
+        dtype=jnp.float32,
+    ) + params.desired_radius
+
+    return FormationState(
+        agents=agents,
+        goal=goal,
+        obstacles=obstacles,
+        steps=jnp.zeros((), jnp.int32),
+        key=key,
+    )
+
+
+def compute_obs(
+    agents: Array, goal: Array, params: EnvParams
+) -> Array:
+    """Per-agent local observation (reference simulate.py:150-174).
+
+    Layout per agent i: ``[own_pos/WH, prev_i - own, next_i - own,
+    (goal - own_pos)/WH?]`` where positions are normalized by (width, height)
+    and prev/next are the ring neighbors. The reference's per-agent Python
+    loop becomes two ``jnp.roll``s.
+    """
+    wh = jnp.array([params.width, params.height], dtype=jnp.float32)
+    normalized = agents / wh
+    prev_offset = jnp.roll(normalized, 1, axis=0) - normalized
+    next_offset = jnp.roll(normalized, -1, axis=0) - normalized
+    parts = [normalized, prev_offset, next_offset]
+    if params.goal_in_obs:
+        parts.append((goal - agents) / wh)  # simulate.py:172
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _in_obstacle(agents: Array, obstacles: Array, params: EnvParams) -> Array:
+    """Per-agent obstacle containment flag.
+
+    ``parity`` mode reproduces the reference's inconsistent geometry
+    (SURVEY.md Q2): the obstacle point is the *lower-left corner* of an
+    ``obstacle_size``-sided box (simulate.py:94-98). ``fixed`` mode treats
+    the point as the box *center* with half-extent ``obstacle_size`` —
+    consistent with how the reference places and renders obstacles
+    (simulate.py:126-130).
+    """
+    if params.num_obstacles == 0:
+        return jnp.zeros((agents.shape[0],), dtype=bool)
+    if params.obstacle_mode == "parity":
+        lo = obstacles[:, None, :]
+        hi = lo + params.obstacle_size
+    else:  # "fixed"
+        lo = obstacles[:, None, :] - params.obstacle_size
+        hi = obstacles[:, None, :] + params.obstacle_size
+    inside = jnp.logical_and(lo <= agents[None], agents[None] <= hi)
+    return inside.all(axis=-1).any(axis=0)
+
+
+def compute_reward(
+    agents: Array,
+    goal: Array,
+    out_of_bounds: Array,
+    in_obstacle: Array,
+    params: EnvParams,
+) -> Tuple[Array, Dict[str, Array]]:
+    """Neighbor-mixed per-agent rewards (reference simulate.py:176-229).
+
+    Returns the mixed rewards ``(N,)`` and the reward-term scalars the
+    reference streams to wandb (simulate.py:188-208), computed on-device.
+    """
+    dist_to_goal = jnp.linalg.norm(agents - goal, axis=-1)
+    close_to_goal = dist_to_goal < params.close_goal_dist
+    close_to_goal_reward = params.close_goal_bonus * close_to_goal
+    reward_dist = -params.reward_dist_scale * dist_to_goal
+
+    # Asymmetric spacing penalty: quadratic when too close, linear when too
+    # far (simulate.py:197-205).
+    dist_right = jnp.linalg.norm(agents - jnp.roll(agents, -1, axis=0), axis=-1)
+    dist_left = jnp.linalg.norm(agents - jnp.roll(agents, 1, axis=0), axis=-1)
+    right_diff = dist_right - params.desired_neighbor_dist
+    left_diff = dist_left - params.desired_neighbor_dist
+    reward_right = -params.neighbor_penalty_scale * jnp.where(
+        right_diff < 0, right_diff**2, right_diff
+    )
+    reward_left = -params.neighbor_penalty_scale * jnp.where(
+        left_diff < 0, left_diff**2, left_diff
+    )
+
+    individual = (
+        reward_dist
+        + close_to_goal_reward
+        + reward_right
+        + reward_left
+        - params.oob_penalty * out_of_bounds
+        - params.obstacle_penalty * in_obstacle
+    )
+
+    # Ring-neighbor reward mixing (1-2p)*r_i + p*(r_{i-1} + r_{i+1})
+    # (simulate.py:222-229), as rolls instead of a Python loop.
+    rho = params.share_reward_ratio
+    mixed = (1.0 - 2.0 * rho) * individual + rho * (
+        jnp.roll(individual, 1, axis=0) + jnp.roll(individual, -1, axis=0)
+    )
+
+    metrics = {
+        "close_to_goal_reward": close_to_goal_reward.mean(),
+        "reward_dist": reward_dist.mean(),
+        "reward_right_neighbor": reward_right.mean(),
+        "reward_left_neighbor": reward_left.mean(),
+    }
+    return mixed, metrics
+
+
+def compute_metrics(
+    agents: Array, goal: Array, params: EnvParams
+) -> Dict[str, Array]:
+    """Side-effect-free progress metrics (reference simulate.py:238-254).
+
+    ``std_dist_to_neighbor`` uses the unbiased (n-1) estimator to match
+    ``torch.Tensor.std``.
+    """
+    dist_to_goal = jnp.linalg.norm(agents - goal, axis=-1)
+    dist_right = jnp.linalg.norm(agents - jnp.roll(agents, -1, axis=0), axis=-1)
+    return {
+        "avg_dist_to_goal": dist_to_goal.mean(),
+        "ave_dist_to_neighbor": dist_right.mean(),
+        "std_dist_to_neighbor": dist_right.std(ddof=1),
+    }
+
+
+def step(
+    state: FormationState, velocity: Array, params: EnvParams
+) -> Tuple[FormationState, Transition]:
+    """Advance one formation by one step.
+
+    ``velocity`` is the raw per-agent velocity ``(N, 2)`` — the same contract
+    as the reference's L0 API (``FormationSimulator.step``, simulate.py:70).
+    Action scaling from policy space [-1, 1] lives in the vec adapter, as in
+    the reference (vectorized_env.py:69-70, SURVEY.md Q8).
+
+    Follows the reference step order exactly (simulate.py:70-118): integrate,
+    flag + clip bounds, obstacle containment, reward (on pre-reset state),
+    timeout check against the pre-increment counter (Q1), auto-reset, then
+    metrics and observation on the (possibly reset) state.
+    """
+    agents = state.agents + velocity
+
+    out_of_bounds = (
+        (agents[:, 0] <= 0.0)
+        | (agents[:, 1] <= 0.0)
+        | (agents[:, 0] >= params.width)
+        | (agents[:, 1] >= params.height)
+    )
+    agents = jnp.clip(
+        agents,
+        jnp.zeros((2,), jnp.float32),
+        jnp.array([params.width, params.height], jnp.float32),
+    )
+
+    in_obstacle = _in_obstacle(agents, state.obstacles, params)
+
+    reward, reward_metrics = compute_reward(
+        agents, state.goal, out_of_bounds, in_obstacle, params
+    )
+
+    if params.strict_parity:
+        # Q1: pre-increment check -> episodes run max_steps + 2 steps.
+        done = state.steps > params.max_steps
+    else:
+        done = state.steps + 1 >= params.max_steps
+        if params.goal_termination:
+            dist_to_goal = jnp.linalg.norm(agents - state.goal, axis=-1)
+            done = done | (dist_to_goal < params.close_goal_dist).all()
+
+    stepped = FormationState(
+        agents=agents,
+        goal=state.goal,
+        obstacles=state.obstacles,
+        steps=state.steps + 1,
+        key=state.key,
+    )
+    fresh = reset(state.key, params)
+    next_state = tree_select(done, fresh, stepped)
+
+    obs = compute_obs(next_state.agents, next_state.goal, params)
+    metrics = compute_metrics(next_state.agents, next_state.goal, params)
+    metrics.update(reward_metrics)
+    metrics["reward"] = reward.mean()
+
+    return next_state, Transition(
+        obs=obs, reward=reward, done=done, metrics=metrics
+    )
+
+
+# ---------------------------------------------------------------------------
+# Batched (vmapped) wrappers — the TPU replacement for the reference's
+# sequential formation loop (vectorized_env.py:71-81).
+# ---------------------------------------------------------------------------
+
+
+def reset_batch(
+    key: Array, params: EnvParams, num_formations: int
+) -> FormationState:
+    """Reset ``num_formations`` independent formations (leading axis M)."""
+    keys = jax.random.split(key, num_formations)
+    return jax.vmap(reset, in_axes=(0, None))(keys, params)
+
+
+def step_batch(
+    state: FormationState, velocity: Array, params: EnvParams
+) -> Tuple[FormationState, Transition]:
+    """Step a batch of formations: state leaves and ``velocity`` carry a
+    leading formation axis M; ``velocity`` is ``(M, N, 2)``."""
+    return jax.vmap(step, in_axes=(0, 0, None))(state, velocity, params)
+
+
+def make_vec_env(
+    params: EnvParams, num_formations: int
+) -> Tuple[
+    Callable[[Array], Tuple[FormationState, Array]],
+    Callable[[FormationState, Array], Tuple[FormationState, Transition]],
+]:
+    """Build jitted ``(reset_fn, step_fn)`` closed over static params.
+
+    ``reset_fn(key) -> (state, obs)`` with obs ``(M, N, obs_dim)``;
+    ``step_fn(state, actions)`` takes policy actions in [-1, 1] shaped
+    ``(M, N, 2)`` and applies the ``max_speed`` scaling, mirroring the
+    reference's L1 adapter contract (vectorized_env.py:68-82).
+    """
+
+    @jax.jit
+    def reset_fn(key: Array) -> Tuple[FormationState, Array]:
+        state = reset_batch(key, params, num_formations)
+        obs = jax.vmap(compute_obs, in_axes=(0, 0, None))(
+            state.agents, state.goal, params
+        )
+        return state, obs
+
+    @jax.jit
+    def step_fn(
+        state: FormationState, actions: Array
+    ) -> Tuple[FormationState, Transition]:
+        velocity = params.max_speed * actions
+        return step_batch(state, velocity, params)
+
+    return reset_fn, step_fn

@@ -1,0 +1,46 @@
+"""Space metadata for the formation environment.
+
+The reference exposes gymnasium ``spaces.Box`` metadata on its VecEnv
+adapter (vectorized_env.py:34-35): per-agent action ``(2,)`` in [-1, 1] and
+observation ``(obs_dim,)`` nominally in [-1, 1] (bounds are declarative, not
+enforced — SURVEY.md Q10). This module carries the same metadata without a
+gym dependency in the compute path; the compat layer converts to gymnasium
+spaces when a frontend needs them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+from marl_distributedformation_tpu.env.types import EnvParams
+
+
+@dataclasses.dataclass(frozen=True)
+class Box:
+    low: float
+    high: float
+    shape: Tuple[int, ...]
+    dtype: type = np.float32
+
+    def sample(self, rng: np.random.Generator) -> np.ndarray:
+        return rng.uniform(self.low, self.high, self.shape).astype(self.dtype)
+
+    def to_gymnasium(self):
+        from gymnasium import spaces  # local import: frontends only
+
+        return spaces.Box(
+            low=self.low, high=self.high, shape=self.shape, dtype=self.dtype
+        )
+
+
+def action_space(params: EnvParams) -> Box:
+    """Per-agent action space (reference vectorized_env.py:34)."""
+    return Box(low=-1.0, high=1.0, shape=(params.act_dim,))
+
+
+def observation_space(params: EnvParams) -> Box:
+    """Per-agent observation space (reference vectorized_env.py:35)."""
+    return Box(low=-1.0, high=1.0, shape=(params.obs_dim,))

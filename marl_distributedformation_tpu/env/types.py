@@ -1,0 +1,144 @@
+"""State and parameter types for the formation environment.
+
+The reference keeps environment state as mutable attributes on a
+``FormationSimulator`` object (reference ``simulate.py:11-61``). Here state is
+an immutable pytree (``FormationState``) and all static configuration lives in
+a hashable frozen dataclass (``EnvParams``) so every step function can be
+traced once by XLA and ``vmap``-ed over thousands of formations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvParams:
+    """Static environment configuration (compile-time constants).
+
+    Defaults mirror the reference simulator's hardcoded values
+    (``simulate.py:13-31``): a 400x600 world, desired formation radius 60,
+    1000-step episode budget, reward-sharing ratio 0.25.
+    """
+
+    num_agents: int = 5
+    num_obstacles: int = 0
+    width: float = 400.0
+    height: float = 600.0
+    obstacle_size: float = 10.0
+    max_steps: int = 1000
+    desired_radius: float = 60.0
+    share_reward_ratio: float = 0.25  # rho in [0, 0.5]; cfg key wired for real
+    #   (the reference's cfg value is dead — see SURVEY.md Q6)
+    goal_in_obs: bool = True
+    max_speed: float = 10.0  # action scaling, reference vectorized_env.py:69
+
+    # Reward constants (reference simulate.py:183-215).
+    close_goal_dist: float = 100.0
+    close_goal_bonus: float = 10.0
+    reward_dist_scale: float = 0.1
+    neighbor_penalty_scale: float = 0.01
+    oob_penalty: float = 100.0
+    obstacle_penalty: float = 100.0
+
+    # Reset distribution constants (reference simulate.py:124-143).
+    agent_spawn_band: float = 100.0  # agents spawn in the bottom 100 px
+    obstacle_margin_band: float = 100.0  # no obstacles in top/bottom 100 px
+
+    # Behavior flags.
+    strict_parity: bool = True
+    """Reproduce the reference's quirks exactly (SURVEY.md §8):
+    Q1 — episodes last ``max_steps + 2`` steps (done when the pre-increment
+    step counter exceeds ``max_steps``, reference simulate.py:111,231);
+    Q3 — termination on timeout only (goal-reached termination is commented
+    out in the reference, simulate.py:233-234).
+    When False: episodes last exactly ``max_steps`` steps and
+    ``goal_termination`` may end them early."""
+
+    goal_termination: bool = False
+    """End the episode when every agent is within ``close_goal_dist`` of the
+    goal. Only honored when ``strict_parity`` is False (the reference ships
+    with this disabled)."""
+
+    obstacle_mode: str = "parity"
+    """``"parity"``: the reference's inconsistent geometry (Q2) — the obstacle
+    point is treated as the lower-left corner of an ``obstacle_size``-sided box
+    for collision (simulate.py:96) while placement/rendering treat it as the
+    center of a ``2*obstacle_size`` box (simulate.py:126-130).
+    ``"fixed"``: consistent geometry — the point is the center of a
+    ``2*obstacle_size``-sided box for placement, collision, and rendering."""
+
+    def __post_init__(self) -> None:
+        assert self.num_agents >= 2, "ring topology needs at least 2 agents"
+        assert 0.0 <= self.share_reward_ratio <= 0.5, (
+            "share_reward_ratio must be in [0, 0.5] (reference simulate.py:28)"
+        )
+        assert self.obstacle_mode in ("parity", "fixed")
+
+    @property
+    def desired_neighbor_dist(self) -> float:
+        """Chord length of a regular ``num_agents``-gon of radius
+        ``desired_radius`` (reference simulate.py:26)."""
+        return float(
+            2.0 * self.desired_radius * np.sin(np.pi / self.num_agents)
+        )
+
+    @property
+    def obs_dim(self) -> int:
+        """Per-agent observation width: 6, +2 when the relative goal is
+        appended (reference vectorized_env.py:28-31)."""
+        return 8 if self.goal_in_obs else 6
+
+    @property
+    def act_dim(self) -> int:
+        return 2
+
+    def replace(self, **changes: Any) -> "EnvParams":
+        return dataclasses.replace(self, **changes)
+
+
+@struct.dataclass
+class FormationState:
+    """Per-formation dynamic state.
+
+    Shapes are for a single formation; batched code ``vmap``s over a leading
+    formation axis M. ``key`` is a per-formation PRNG stream so resets are
+    independent and deterministic (the reference has no seeding at all —
+    SURVEY.md Q9).
+    """
+
+    agents: jax.Array  # (N, 2) float32 positions
+    goal: jax.Array  # (2,) float32
+    obstacles: jax.Array  # (K, 2) float32 (K may be 0)
+    steps: jax.Array  # () int32 — steps completed since reset
+    key: jax.Array  # PRNG key for this formation's reset stream
+
+
+@struct.dataclass
+class Transition:
+    """Everything ``step`` returns besides the next state.
+
+    ``done`` is scalar per formation (the reference broadcasts it to all
+    agents in the vec adapter, vectorized_env.py:79). ``metrics`` holds the
+    reference's observability contract scalars (simulate.py:238-254) plus the
+    per-agent reward terms it logs (simulate.py:188-208), all computed
+    on-device with no host callbacks.
+    """
+
+    obs: jax.Array  # (N, obs_dim) float32
+    reward: jax.Array  # (N,) float32 — neighbor-mixed rewards
+    done: jax.Array  # () bool
+    metrics: Dict[str, jax.Array]
+
+
+def tree_select(pred: jax.Array, on_true: Any, on_false: Any) -> Any:
+    """``jnp.where`` over a pytree with a scalar predicate (broadcasts)."""
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), on_true, on_false
+    )

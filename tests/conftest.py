@@ -1,0 +1,15 @@
+"""Test configuration: force JAX onto CPU with 8 virtual devices.
+
+Must run before the first ``import jax`` anywhere in the test session so
+mesh/sharding tests (SURVEY.md §4) can exercise multi-device code paths
+without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell exports axon (TPU)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,319 @@
+"""Unit tests for the pure-functional formation environment.
+
+Covers the reference semantics documented in SURVEY.md §2.1 (components
+2, 4-7) and the quirk ledger §8 with hand-computed fixtures — the test
+strategy the reference lacks (SURVEY.md §4).
+"""
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marl_distributedformation_tpu.env import (
+    EnvParams,
+    compute_metrics,
+    compute_obs,
+    compute_reward,
+    make_vec_env,
+    reset,
+    reset_batch,
+    step,
+    step_batch,
+)
+
+
+@pytest.fixture
+def params():
+    return EnvParams(num_agents=5)
+
+
+def test_reset_shapes_and_bounds(params):
+    state = reset(jax.random.PRNGKey(0), params)
+    chex.assert_shape(state.agents, (5, 2))
+    chex.assert_shape(state.goal, (2,))
+    chex.assert_shape(state.obstacles, (0, 2))
+    assert state.agents.dtype == jnp.float32
+    assert int(state.steps) == 0
+    # Agents spawn in the bottom 100-px strip (simulate.py:133-135).
+    assert (state.agents[:, 0] >= 0).all() and (state.agents[:, 0] <= 400).all()
+    assert (state.agents[:, 1] >= 0).all() and (state.agents[:, 1] <= 100).all()
+    # Goal keeps a desired_radius margin from every wall (simulate.py:140-143).
+    assert 60 <= float(state.goal[0]) <= 400 - 60
+    assert 60 <= float(state.goal[1]) <= 600 - 60
+
+
+def test_reset_deterministic_per_key(params):
+    a = reset(jax.random.PRNGKey(7), params)
+    b = reset(jax.random.PRNGKey(7), params)
+    c = reset(jax.random.PRNGKey(8), params)
+    chex.assert_trees_all_equal(a, b)
+    assert not np.allclose(np.asarray(a.agents), np.asarray(c.agents))
+
+
+def test_obstacle_reset_band():
+    p = EnvParams(num_agents=4, num_obstacles=16, obstacle_mode="fixed")
+    state = reset(jax.random.PRNGKey(3), p)
+    chex.assert_shape(state.obstacles, (16, 2))
+    ob = np.asarray(state.obstacles)
+    assert (ob[:, 0] >= 10).all() and (ob[:, 0] <= 390).all()
+    # Middle band: y in [100 + size, 500 - size] (simulate.py:127).
+    assert (ob[:, 1] >= 110).all() and (ob[:, 1] <= 490).all()
+
+
+def test_obs_hand_computed():
+    p = EnvParams(num_agents=3)
+    agents = jnp.array([[40.0, 60.0], [80.0, 120.0], [200.0, 300.0]])
+    goal = jnp.array([240.0, 360.0])
+    obs = compute_obs(agents, goal, p)
+    chex.assert_shape(obs, (3, 8))
+    na = np.asarray(agents) / np.array([400.0, 600.0])
+    # Agent 0: prev is agent 2, next is agent 1 (simulate.py:162-167).
+    np.testing.assert_allclose(np.asarray(obs[0, :2]), na[0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(obs[0, 2:4]), na[2] - na[0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(obs[0, 4:6]), na[1] - na[0], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(obs[1, 6:8]),
+        (np.asarray(goal) - np.asarray(agents[1])) / np.array([400.0, 600.0]),
+        rtol=1e-6,
+    )
+
+
+def test_obs_without_goal():
+    p = EnvParams(num_agents=4, goal_in_obs=False)
+    obs = compute_obs(
+        jnp.ones((4, 2)) * 50.0, jnp.array([200.0, 300.0]), p
+    )
+    chex.assert_shape(obs, (4, 6))
+
+
+def test_reward_hand_computed():
+    """Two agents on a line near the goal; every term computed by hand."""
+    p = EnvParams(num_agents=2, share_reward_ratio=0.0)
+    # desired_neighbor_dist = 2*60*sin(pi/2) = 120.
+    assert np.isclose(p.desired_neighbor_dist, 120.0)
+    agents = jnp.array([[200.0, 300.0], [200.0, 400.0]])
+    goal = jnp.array([200.0, 300.0])
+    oob = jnp.zeros(2, bool)
+    in_obs = jnp.zeros(2, bool)
+    reward, terms = compute_reward(agents, goal, oob, in_obs, p)
+    # Agent 0: dist 0 -> close bonus 10, dist term 0; both neighbor dists are
+    # 100 -> diff -20, quadratic penalty 0.01*400 = 4 per side.
+    np.testing.assert_allclose(float(reward[0]), 10.0 - 4.0 - 4.0, rtol=1e-5)
+    # Agent 1: dist 100 -> not close (strict <), dist term -10, same spacing.
+    np.testing.assert_allclose(float(reward[1]), -10.0 - 4.0 - 4.0, rtol=1e-5)
+    assert set(terms) == {
+        "close_to_goal_reward",
+        "reward_dist",
+        "reward_right_neighbor",
+        "reward_left_neighbor",
+    }
+
+
+def test_reward_linear_when_too_far():
+    p = EnvParams(num_agents=2, share_reward_ratio=0.0)
+    agents = jnp.array([[0.0, 0.0], [0.0, 200.0]])
+    goal = jnp.array([200.0, 300.0])
+    reward, _ = compute_reward(
+        agents, goal, jnp.zeros(2, bool), jnp.zeros(2, bool), p
+    )
+    # Spacing 200 vs desired 120 -> linear penalty 0.01*80 = 0.8 per side
+    # (simulate.py:204: quadratic only when too close).
+    d0 = float(jnp.linalg.norm(agents[0] - goal))
+    np.testing.assert_allclose(
+        float(reward[0]), -0.1 * d0 - 0.8 - 0.8, rtol=1e-4
+    )
+
+
+def test_reward_mixing_limits():
+    agents = jnp.array([[10.0, 10.0], [60.0, 30.0], [300.0, 500.0]])
+    goal = jnp.array([200.0, 300.0])
+    oob = jnp.zeros(3, bool)
+    in_obs = jnp.zeros(3, bool)
+    r0, _ = compute_reward(
+        agents, goal, oob, in_obs, EnvParams(num_agents=3, share_reward_ratio=0.0)
+    )
+    rhalf, _ = compute_reward(
+        agents, goal, oob, in_obs, EnvParams(num_agents=3, share_reward_ratio=0.5)
+    )
+    # rho=0.5: own reward fully replaced by the neighbor average
+    # (simulate.py:228-229).
+    expected = 0.5 * (np.roll(np.asarray(r0), 1) + np.roll(np.asarray(r0), -1))
+    np.testing.assert_allclose(np.asarray(rhalf), expected, rtol=1e-5)
+
+
+def test_out_of_bounds_penalty_and_clip(params):
+    state = reset(jax.random.PRNGKey(0), params)
+    # Push every agent far left/down out of the box.
+    vel = -jnp.ones((5, 2)) * 1000.0
+    next_state, tr = step(state, vel, params)
+    assert (np.asarray(next_state.agents) >= 0).all()
+    # With rho=0.25 mixing, every agent carries the full -100 penalty
+    # because all agents are out of bounds simultaneously.
+    assert (np.asarray(tr.reward) < -90).all()
+
+
+def test_obstacle_containment_parity_geometry():
+    """Q2: parity mode treats the obstacle point as a lower-left corner of an
+    obstacle_size box; fixed mode as the center of a 2*obstacle_size box."""
+    from marl_distributedformation_tpu.env.formation import _in_obstacle
+
+    p = EnvParams(num_agents=2, num_obstacles=1)
+    obstacles = jnp.array([[200.0, 300.0]])
+    # Agent 0 inside [200,210]x[300,310]; agent 1 at the *center-box-only*
+    # location (195, 295), inside the rendered box but not the parity box.
+    agents = jnp.array([[205.0, 305.0], [195.0, 295.0]])
+    flags = _in_obstacle(agents, obstacles, p)
+    assert bool(flags[0]) and not bool(flags[1])
+
+    p_fixed = p.replace(obstacle_mode="fixed")
+    flags_fixed = _in_obstacle(agents, obstacles, p_fixed)
+    # Fixed mode: center box [190,210]x[290,310] contains both agents.
+    assert bool(flags_fixed[0]) and bool(flags_fixed[1])
+
+    # The flag feeds a -100 penalty into the reward (simulate.py:215-217).
+    r_hit, _ = compute_reward(
+        agents,
+        jnp.array([205.0, 305.0]),
+        jnp.zeros(2, bool),
+        flags,
+        p.replace(share_reward_ratio=0.0),
+    )
+    r_clear, _ = compute_reward(
+        agents,
+        jnp.array([205.0, 305.0]),
+        jnp.zeros(2, bool),
+        jnp.zeros(2, bool),
+        p.replace(share_reward_ratio=0.0),
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_hit - r_clear), [-100.0, 0.0], atol=1e-5
+    )
+
+
+def test_episode_length_strict_parity():
+    """Q1: done fires when the pre-increment counter exceeds max_steps,
+    so episodes run max_steps + 2 steps (simulate.py:111,231)."""
+    p = EnvParams(num_agents=3, max_steps=10)
+    state = reset(jax.random.PRNGKey(0), p)
+
+    def body(carry, _):
+        st, done_step, i = carry
+        st, tr = step(st, jnp.zeros((3, 2)), p)
+        done_step = jnp.where(
+            (done_step < 0) & tr.done, i, done_step
+        )
+        return (st, done_step, i + 1), tr.done
+    (_, done_step, _), dones = jax.lax.scan(
+        body, (state, jnp.int32(-1), jnp.int32(1)), None, length=20
+    )
+    # 1-based step index at which done first fires: max_steps + 2 = 12.
+    assert int(done_step) == 12
+    assert int(dones.sum()) == 1  # counter resets with the episode
+
+
+def test_episode_length_exact_when_not_parity():
+    p = EnvParams(num_agents=3, max_steps=10, strict_parity=False)
+    state = reset(jax.random.PRNGKey(0), p)
+    done_at = None
+    for i in range(1, 15):
+        state, tr = step(state, jnp.zeros((3, 2)), p)
+        if bool(tr.done):
+            done_at = i
+            break
+    assert done_at == 10
+
+
+def test_goal_termination_flag():
+    p = EnvParams(
+        num_agents=3, strict_parity=False, goal_termination=True
+    )
+    state = reset(jax.random.PRNGKey(0), p)
+    # Teleport everyone onto the goal via a crafted velocity.
+    vel = state.goal[None, :] - state.agents
+    _, tr = step(state, vel, p)
+    assert bool(tr.done)
+
+
+def test_auto_reset_returns_next_episode_obs():
+    """SB3 VecEnv convention (simulate.py:113-118): on done, the returned
+    obs belongs to the next episode while the reward is terminal."""
+    p = EnvParams(num_agents=3, max_steps=0, strict_parity=False)
+    state = reset(jax.random.PRNGKey(5), p)
+    next_state, tr = step(state, jnp.zeros((3, 2)), p)
+    assert bool(tr.done)
+    assert int(next_state.steps) == 0
+    expected_fresh = reset(state.key, p)
+    chex.assert_trees_all_close(next_state.agents, expected_fresh.agents)
+    np.testing.assert_allclose(
+        np.asarray(tr.obs),
+        np.asarray(compute_obs(expected_fresh.agents, expected_fresh.goal, p)),
+        rtol=1e-6,
+    )
+    # A new goal was drawn (old goal overwhelmingly unlikely to repeat).
+    assert not np.allclose(np.asarray(next_state.goal), np.asarray(state.goal))
+
+
+def test_metrics_match_numpy():
+    p = EnvParams(num_agents=4)
+    agents = jnp.array(
+        [[10.0, 20.0], [50.0, 80.0], [90.0, 10.0], [200.0, 400.0]]
+    )
+    goal = jnp.array([100.0, 100.0])
+    m = compute_metrics(agents, goal, p)
+    a = np.asarray(agents)
+    d_goal = np.linalg.norm(a - np.asarray(goal), axis=1)
+    d_right = np.linalg.norm(a - np.roll(a, -1, axis=0), axis=1)
+    np.testing.assert_allclose(float(m["avg_dist_to_goal"]), d_goal.mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(m["ave_dist_to_neighbor"]), d_right.mean(), rtol=1e-5
+    )
+    # torch .std() is the unbiased estimator (ddof=1).
+    np.testing.assert_allclose(
+        float(m["std_dist_to_neighbor"]), d_right.std(ddof=1), rtol=1e-5
+    )
+
+
+def test_batch_matches_single(params):
+    """vmap over formations is semantically the reference's sequential loop
+    (vectorized_env.py:71-81)."""
+    M = 4
+    state = reset_batch(jax.random.PRNGKey(1), params, M)
+    vel = jax.random.normal(jax.random.PRNGKey(2), (M, 5, 2))
+    batched_state, batched_tr = step_batch(state, vel, params)
+    for i in range(M):
+        single = jax.tree_util.tree_map(lambda x: x[i], state)
+        s_state, s_tr = step(single, vel[i], params)
+        chex.assert_trees_all_close(
+            jax.tree_util.tree_map(lambda x: x[i], batched_state), s_state,
+            rtol=1e-6,
+        )
+        chex.assert_trees_all_close(
+            jax.tree_util.tree_map(lambda x: x[i], batched_tr), s_tr,
+            rtol=1e-6,
+        )
+
+
+def test_make_vec_env_contract(params):
+    reset_fn, step_fn = make_vec_env(params, num_formations=3)
+    state, obs = reset_fn(jax.random.PRNGKey(0))
+    chex.assert_shape(obs, (3, 5, 8))
+    actions = jnp.clip(
+        jax.random.normal(jax.random.PRNGKey(1), (3, 5, 2)), -1, 1
+    )
+    state2, tr = step_fn(state, actions)
+    chex.assert_shape(tr.obs, (3, 5, 8))
+    chex.assert_shape(tr.reward, (3, 5))
+    chex.assert_shape(tr.done, (3,))
+    # max_speed scaling (vectorized_env.py:69-70): displacement = 10 * action
+    # wherever no clipping happened.
+    moved = np.asarray(state2.agents - state.agents)
+    inside = (
+        (np.asarray(state2.agents) > 0) & (np.asarray(state2.agents) < [400, 600])
+    ).all(axis=-1, keepdims=True)
+    np.testing.assert_allclose(
+        np.where(inside, moved, 0.0),
+        np.where(inside, 10.0 * np.asarray(actions), 0.0),
+        atol=1e-4,
+    )

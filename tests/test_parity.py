@@ -1,0 +1,222 @@
+"""Golden-parity tests: the JAX environment vs the actual reference code.
+
+Loads the reference's ``FormationSimulator`` from /root/reference (read-only)
+with a stubbed ``wandb`` module, forces identical states on both
+implementations, and asserts obs/reward/done agreement to fp32 tolerance over
+multi-step trajectories — the parity gate from SURVEY.md §7 step 2.
+
+Skipped automatically if the reference checkout or torch is unavailable.
+"""
+
+import importlib.util
+import sys
+import types
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marl_distributedformation_tpu.env import (
+    EnvParams,
+    FormationState,
+    compute_obs,
+    control,
+    reset,
+    step,
+)
+
+REFERENCE_DIR = Path("/root/reference")
+
+torch = pytest.importorskip("torch")
+
+if not (REFERENCE_DIR / "simulate.py").exists():  # pragma: no cover
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+
+def _load_reference_simulate():
+    """Import the reference simulate.py with wandb stubbed out."""
+    if "wandb" not in sys.modules:
+        stub = types.ModuleType("wandb")
+        stub.log = lambda *a, **k: None
+        stub.init = lambda *a, **k: None
+        sys.modules["wandb"] = stub
+    spec = importlib.util.spec_from_file_location(
+        "_reference_simulate", REFERENCE_DIR / "simulate.py"
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+ref_sim = _load_reference_simulate()
+
+
+def make_pair(num_agents, seed, share_reward_ratio=0.25, goal_in_obs=True):
+    """Build (reference simulator, jax state, params) with identical state."""
+    params = EnvParams(
+        num_agents=num_agents,
+        share_reward_ratio=share_reward_ratio,
+        goal_in_obs=goal_in_obs,
+    )
+    sim = ref_sim.FormationSimulator(
+        num_agents=num_agents,
+        num_obstacles=0,
+        share_reward_ratio=share_reward_ratio,
+        goal_in_obs=goal_in_obs,
+        visualize=False,
+        log=False,
+    )
+    state = reset(jax.random.PRNGKey(seed), params)
+    # Force the torch side onto the JAX side's sampled state.
+    sim.agents = torch.tensor(np.asarray(state.agents), dtype=torch.float32)
+    sim.goal = torch.tensor(np.asarray(state.goal), dtype=torch.float32)
+    sim.obstacles = torch.zeros((0, 2))
+    sim.steps_since_reset = 0
+    return sim, state, params
+
+
+@pytest.mark.parametrize("num_agents", [2, 3, 5, 20])
+def test_step_parity_random_trajectory(num_agents):
+    sim, state, params = make_pair(num_agents, seed=num_agents)
+    rng = np.random.default_rng(0)
+    for t in range(25):
+        vel = rng.uniform(-10, 10, (num_agents, 2)).astype(np.float32)
+        ref_obs, ref_rew, ref_done, _ = sim.step(torch.tensor(vel))
+        state, tr = step(state, jnp.asarray(vel), params)
+        assert bool(tr.done) == bool(ref_done)
+        np.testing.assert_allclose(
+            np.asarray(tr.reward),
+            ref_rew.numpy(),
+            rtol=1e-4,
+            atol=1e-3,
+            err_msg=f"reward diverged at t={t}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(tr.obs),
+            ref_obs.numpy(),
+            rtol=1e-4,
+            atol=1e-5,
+            err_msg=f"obs diverged at t={t}",
+        )
+        # Positions stay in lockstep, so drift cannot accumulate silently.
+        np.testing.assert_allclose(
+            np.asarray(state.agents), sim.agents.numpy(), rtol=1e-4, atol=1e-3
+        )
+
+
+def test_step_parity_extreme_actions_hit_bounds():
+    sim, state, params = make_pair(4, seed=11)
+    for vel in [
+        np.full((4, 2), 1000.0, np.float32),  # slam into the top-right corner
+        np.full((4, 2), -1000.0, np.float32),  # slam into the origin
+        np.zeros((4, 2), np.float32),  # sit on the boundary (<=/>= flags)
+    ]:
+        ref_obs, ref_rew, ref_done, _ = sim.step(torch.tensor(vel))
+        state, tr = step(state, jnp.asarray(vel), params)
+        np.testing.assert_allclose(
+            np.asarray(tr.reward), ref_rew.numpy(), rtol=1e-4, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(tr.obs), ref_obs.numpy(), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_step_parity_no_goal_in_obs():
+    sim, state, params = make_pair(5, seed=3, goal_in_obs=False)
+    vel = np.ones((5, 2), np.float32)
+    ref_obs, ref_rew, _, _ = sim.step(torch.tensor(vel))
+    state, tr = step(state, jnp.asarray(vel), params)
+    assert tr.obs.shape == (5, 6) and ref_obs.shape == (5, 6)
+    np.testing.assert_allclose(np.asarray(tr.obs), ref_obs.numpy(), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(tr.reward), ref_rew.numpy(), rtol=1e-4, atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("rho", [0.0, 0.1, 0.5])
+def test_reward_mixing_parity(rho):
+    sim, state, params = make_pair(6, seed=7, share_reward_ratio=rho)
+    vel = np.zeros((6, 2), np.float32)
+    _, ref_rew, _, _ = sim.step(torch.tensor(vel))
+    _, tr = step(state, jnp.asarray(vel), params)
+    np.testing.assert_allclose(
+        np.asarray(tr.reward), ref_rew.numpy(), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_episode_length_parity():
+    """Q1 measured end-to-end: both implementations run max_steps + 2 steps."""
+    sim, state, params = make_pair(2, seed=1)
+    sim.max_steps = 5
+    params = params.replace(max_steps=5)
+    zero = np.zeros((2, 2), np.float32)
+    ref_done_at = jax_done_at = None
+    for t in range(1, 12):
+        _, _, ref_done, _ = sim.step(torch.tensor(zero))
+        state, tr = step(state, jnp.asarray(zero), params)
+        if ref_done and ref_done_at is None:
+            ref_done_at = t
+        if bool(tr.done) and jax_done_at is None:
+            jax_done_at = t
+    assert ref_done_at == jax_done_at == 7  # max_steps + 2
+
+
+def test_baseline_controller_trajectory_parity():
+    """The JAX potential-field controller reproduces the reference
+    ``control`` trajectory (simulate.py:256-319) step for step."""
+    num_agents = 10  # reference requires even N (simulate.py:279)
+    sim, state, params = make_pair(num_agents, seed=42)
+    for t in range(60):
+        ref_sim.control(t, sim)  # steps the torch env internally
+        vel = control(state.agents, state.goal, state.obstacles, params)
+        state, tr = step(state, vel, params)
+        np.testing.assert_allclose(
+            np.asarray(state.agents),
+            sim.agents.numpy(),
+            rtol=1e-3,
+            atol=5e-2,
+            err_msg=f"baseline trajectory diverged at t={t}",
+        )
+
+
+def test_baseline_return_parity():
+    """Return-parity gate (BASELINE.json config 1): total return of the JAX
+    env+controller over a fixed horizon is within 1% of the reference's."""
+    num_agents = 10
+    # control() discards step outputs, so capture the velocity it would
+    # apply via a recording proxy and step the torch env explicitly.
+    sim2, state2, params = make_pair(num_agents, seed=123)
+    ref_total = 0.0
+    jax_total = 0.0
+    for t in range(200):
+        tvel = _torch_control_velocity(sim2)
+        _, ref_rew, _, _ = sim2.step(tvel)
+        ref_total += float(ref_rew.mean())
+        vel = control(state2.agents, state2.goal, state2.obstacles, params)
+        state2, tr = step(state2, vel, params)
+        jax_total += float(tr.reward.mean())
+    assert abs(jax_total - ref_total) <= 0.01 * abs(ref_total), (
+        f"jax return {jax_total} vs reference {ref_total}"
+    )
+
+
+def _torch_control_velocity(sim):
+    """Capture the velocity the reference controller would apply, by calling
+    it against a recording proxy (control() both computes and steps)."""
+
+    class _Recorder:
+        def __init__(self, inner):
+            self._inner = inner
+            self.velocity = None
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def step(self, velocity):
+            self.velocity = velocity
+
+    rec = _Recorder(sim)
+    ref_sim.control(0, rec)
+    return rec.velocity
