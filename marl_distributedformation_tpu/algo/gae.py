@@ -1,0 +1,57 @@
+"""Generalized Advantage Estimation via ``lax.scan``.
+
+Capability replacement for SB3's ``RolloutBuffer.compute_returns_and_advantage``
+(consumed by the reference through ``PPO.learn``, vectorized_env.py:134;
+SURVEY.md §2.2). Episodes that end inside the rollout are handled through the
+``dones`` mask; because the reference's VecEnv supplies no
+``terminal_observation`` (SURVEY.md Q4), terminal steps simply don't
+bootstrap — matching SB3's behavior on this env exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def compute_gae(
+    rewards: Array,
+    values: Array,
+    dones: Array,
+    last_value: Array,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[Array, Array]:
+    """Compute advantages and returns.
+
+    Args:
+      rewards, values, dones: ``(T, ...)`` time-major rollout arrays;
+        ``dones[t]`` is True when the transition at ``t`` ended an episode.
+      last_value: ``(...)`` value of the observation after the final step.
+
+    Returns:
+      ``(advantages, returns)`` with ``returns = advantages + values``
+      (TD(lambda) targets, as in SB3).
+    """
+    next_values = jnp.concatenate(
+        [values[1:], last_value[None]], axis=0
+    )
+    non_terminal = 1.0 - dones.astype(values.dtype)
+    deltas = rewards + gamma * next_values * non_terminal - values
+
+    def body(next_adv, x):
+        delta, nt = x
+        adv = delta + gamma * gae_lambda * nt * next_adv
+        return adv, adv
+
+    _, advantages = jax.lax.scan(
+        body,
+        jnp.zeros_like(last_value),
+        (deltas, non_terminal),
+        reverse=True,
+    )
+    return advantages, advantages + values

@@ -1,0 +1,4 @@
+"""Policy/value networks (flax) and action distributions."""
+
+from marl_distributedformation_tpu.models.mlp import MLPActorCritic  # noqa: F401
+from marl_distributedformation_tpu.models import distributions  # noqa: F401

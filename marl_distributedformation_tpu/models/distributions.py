@@ -1,0 +1,42 @@
+"""Diagonal-Gaussian action distribution with state-independent log-std.
+
+Functional equivalent of the action distribution the reference gets from SB3
+(``'MlpPolicy'`` builds a ``DiagGaussianDistribution`` with one learned
+``log_std`` vector shared across states; reference vectorized_env.py:126).
+All ops are shape-polymorphic over leading batch axes.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+def sample(key: Array, mean: Array, log_std: Array) -> Array:
+    """Reparameterized draw: ``mean + exp(log_std) * eps``."""
+    eps = jax.random.normal(key, mean.shape, mean.dtype)
+    return mean + jnp.exp(log_std) * eps
+
+
+def log_prob(actions: Array, mean: Array, log_std: Array) -> Array:
+    """Log density summed over the action dimension (independent dims)."""
+    z = (actions - mean) * jnp.exp(-log_std)
+    per_dim = -0.5 * (z**2 + _LOG_2PI) - log_std
+    return per_dim.sum(axis=-1)
+
+
+def entropy(log_std: Array) -> Array:
+    """Differential entropy; state-independent, shape ``()``."""
+    return (log_std + 0.5 * (1.0 + _LOG_2PI)).sum()
+
+
+def mode(mean: Array) -> Array:
+    """Deterministic action (used by ``predict(deterministic=True)``
+    playback, reference visualize_policy.py:16)."""
+    return mean

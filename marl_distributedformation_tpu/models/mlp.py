@@ -1,0 +1,69 @@
+"""MLP actor-critic matching the reference's SB3 ``'MlpPolicy'`` shape.
+
+The reference trains ``PPO('MlpPolicy', ...)`` (vectorized_env.py:126): two
+separate tanh MLPs of width [64, 64] for policy and value, orthogonal init
+(gain sqrt(2) hidden, 0.01 action head, 1.0 value head), and a learned
+state-independent ``log_std``.
+
+``log_std_init`` is a *real* knob here: the reference sets
+``model.policy.log_std_init = -2`` after construction, which is a no-op —
+SB3 had already created the parameter at 0.0 (SURVEY.md Q5). Parity default
+is therefore 0.0; pass -2.0 to get what the reference author intended.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Array = jax.Array
+
+
+class MLPActorCritic(nn.Module):
+    """Per-agent actor-critic over local observations.
+
+    Every agent in every formation shares these parameters — the central
+    MARL trick the reference implements by flattening M formations x N
+    agents into ``num_envs = M*N`` SB3 environments (vectorized_env.py:32,
+    SURVEY.md §2.1 #10).
+    """
+
+    act_dim: int = 2
+    hidden: Sequence[int] = (64, 64)
+    log_std_init: float = 0.0
+
+    @nn.compact
+    def __call__(self, obs: Array) -> Tuple[Array, Array, Array]:
+        """Returns ``(action_mean, log_std, value)``; ``obs`` may carry any
+        leading batch axes."""
+        hidden_init = nn.initializers.orthogonal(jnp.sqrt(2.0))
+
+        pi = obs
+        for i, width in enumerate(self.hidden):
+            pi = nn.tanh(
+                nn.Dense(width, kernel_init=hidden_init, name=f"pi_{i}")(pi)
+            )
+        mean = nn.Dense(
+            self.act_dim,
+            kernel_init=nn.initializers.orthogonal(0.01),
+            name="pi_head",
+        )(pi)
+
+        vf = obs
+        for i, width in enumerate(self.hidden):
+            vf = nn.tanh(
+                nn.Dense(width, kernel_init=hidden_init, name=f"vf_{i}")(vf)
+            )
+        value = nn.Dense(
+            1, kernel_init=nn.initializers.orthogonal(1.0), name="vf_head"
+        )(vf)
+
+        log_std = self.param(
+            "log_std",
+            nn.initializers.constant(self.log_std_init),
+            (self.act_dim,),
+        )
+        return mean, log_std, value.squeeze(-1)

@@ -1,0 +1,10 @@
+"""Device-mesh parallelism: dp over formations, ring exchange over agents."""
+
+from marl_distributedformation_tpu.parallel.mesh import (  # noqa: F401
+    formation_sharding,
+    make_mesh,
+    make_shard_fn,
+    replicate,
+    replicated,
+    shard_batch,
+)

@@ -1,0 +1,102 @@
+"""Device-mesh construction and sharding placement.
+
+The reference has no distributed machinery at all (SURVEY.md §2.1: no
+NCCL/MPI/multi-process anything — "distributed" in its name means
+*decentralized control*). The TPU-native scaling story is therefore designed
+fresh: formations are the data axis, sharded over a ``jax.sharding.Mesh``
+('dp'); parameters are replicated; XLA inserts the gradient ``psum`` over ICI
+because the jitted update consumes dp-sharded minibatches with replicated
+params. An optional 'sp' axis shards the *agent* ring dimension for very
+large swarms (see ``parallel/ring.py``).
+
+Works identically on real TPU meshes and on CPU test meshes created with
+``--xla_force_host_platform_device_count``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axis_sizes: Dict[str, int]) -> Mesh:
+    """Build a mesh with named axes, e.g. ``{"dp": 4}`` or
+    ``{"dp": 4, "sp": 2}``. Total size must divide the device count; use
+    size -1 for one axis to mean "all remaining devices"."""
+    names = tuple(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    n_devices = len(jax.devices())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n_devices // known
+    total = int(np.prod(sizes))
+    if total > n_devices:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices; "
+            f"only {n_devices} available"
+        )
+    devices = mesh_utils.create_device_mesh(
+        tuple(sizes), devices=jax.devices()[:total]
+    )
+    return Mesh(devices, names)
+
+
+def formation_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading formation axis M over 'dp'; everything else
+    (agents, coordinates) stays local to the chip."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(tree: Any, mesh: Mesh) -> Any:
+    """Place a pytree whose leaves all carry a leading formation axis."""
+    return jax.device_put(tree, formation_sharding(mesh))
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    return jax.device_put(tree, replicated(mesh))
+
+
+def make_shard_fn(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    mesh: Optional[Mesh] = None,
+) -> Callable[[Any, Any, Any], Tuple[Any, Any, Any]]:
+    """Build the ``shard_fn`` hook ``Trainer`` applies after initialization:
+    replicate the train state, shard env state + obs over 'dp'.
+
+    The jitted train iteration then runs SPMD: rollouts and minibatch grads
+    are computed on local formation shards and XLA all-reduces gradients
+    (replicated params + sharded batch => psum over 'dp' on ICI).
+    """
+    the_mesh = mesh or make_mesh(axis_sizes or {"dp": len(jax.devices())})
+    extra_axes = set(the_mesh.shape) - {"dp"}
+    if extra_axes:
+        raise NotImplementedError(
+            f"shard_fn currently places only the 'dp' (formation) axis; "
+            f"mesh has {sorted(extra_axes)}. Agent-axis ('sp') sharding is "
+            "provided by parallel/ring.py and is wired into the trainer "
+            "with the large-swarm configs."
+        )
+
+    def shard_fn(train_state, env_state, obs):
+        dp = the_mesh.shape["dp"]
+        m = obs.shape[0]
+        if m % dp != 0:
+            raise ValueError(
+                f"num_formations={m} not divisible by dp={dp}"
+            )
+        return (
+            replicate(train_state, the_mesh),
+            shard_batch(env_state, the_mesh),
+            shard_batch(obs, the_mesh),
+        )
+
+    shard_fn.mesh = the_mesh
+    return shard_fn

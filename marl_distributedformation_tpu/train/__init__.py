@@ -1,0 +1,6 @@
+"""Training drivers."""
+
+from marl_distributedformation_tpu.train.trainer import (  # noqa: F401
+    TrainConfig,
+    Trainer,
+)

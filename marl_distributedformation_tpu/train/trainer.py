@@ -1,0 +1,268 @@
+"""End-to-end PPO trainer: one jitted iteration = rollout + GAE + update.
+
+Replaces the reference's training driver (``run()``, vectorized_env.py:112-137)
+and the SB3 ``learn`` loop it delegates to (SURVEY.md §3.1). The entire hot
+path — policy forward, action sampling, vectorized env stepping, GAE, and all
+minibatch epochs — is a single XLA program per iteration; the host loop only
+dispatches iterations, emits per-rollout metrics, and writes checkpoints.
+
+Timestep accounting matches SB3: ``num_timesteps`` counts agent-transitions
+(``+= num_envs = M*N`` per vec-step, SURVEY.md §2.2), and the default budget
+is ``5000 * num_formations`` (vectorized_env.py:116,134).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax.training.train_state import TrainState
+
+from marl_distributedformation_tpu.algo import (
+    MinibatchData,
+    PPOConfig,
+    collect_rollout,
+    compute_gae,
+    ppo_update,
+)
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.env.formation import compute_obs, reset_batch
+from marl_distributedformation_tpu.models import MLPActorCritic
+from marl_distributedformation_tpu.utils import (
+    MetricsLogger,
+    Throughput,
+    latest_checkpoint,
+    repo_root,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Run-level configuration (what the reference spreads across cfg,
+    ``run()``, and SB3 constructor arguments)."""
+
+    num_formations: int = 1000  # cfg/config.yaml:3
+    total_timesteps: Optional[int] = None  # default 5000 * M agent-transitions
+    seed: int = 0
+    save_freq: int = 10  # vec-steps between checkpoints (vectorized_env.py:124)
+    checkpoint: bool = True
+    name: str = "default"
+    log_dir: Optional[str] = None  # default <repo>/logs/{name}
+    use_wandb: bool = False
+    resume: bool = False
+    log_interval: int = 1  # emit metrics every k rollouts
+
+
+class Trainer:
+    """Imperative shell around the functional training core.
+
+    ``mesh_axes``/``mesh`` wiring for multi-chip sharding lives in
+    ``parallel/``; pass ``shard_fn`` to place env state and train state on a
+    device mesh — the jitted iteration is sharding-agnostic.
+    """
+
+    def __init__(
+        self,
+        env_params: EnvParams,
+        ppo: PPOConfig = PPOConfig(),
+        config: TrainConfig = TrainConfig(),
+        model: Any = None,
+        shard_fn: Any = None,
+    ) -> None:
+        self.env_params = env_params
+        self.ppo = ppo
+        self.config = config
+        self.num_envs = config.num_formations * env_params.num_agents
+
+        self.model = model or MLPActorCritic(
+            act_dim=env_params.act_dim, log_std_init=ppo.log_std_init
+        )
+
+        key = jax.random.PRNGKey(config.seed)
+        self.key, k_init, k_env = jax.random.split(key, 3)
+        dummy_obs = jnp.zeros((1, env_params.obs_dim), jnp.float32)
+        params = self.model.init(k_init, dummy_obs)
+        self.train_state = TrainState.create(
+            apply_fn=self.model.apply,
+            params=params,
+            tx=ppo.make_optimizer(),
+        )
+
+        self.env_state = reset_batch(
+            k_env, env_params, config.num_formations
+        )
+        self.obs = jax.vmap(compute_obs, in_axes=(0, 0, None))(
+            self.env_state.agents, self.env_state.goal, env_params
+        )
+
+        self._shard_fn = shard_fn
+        if shard_fn is not None:
+            self.train_state, self.env_state, self.obs = shard_fn(
+                self.train_state, self.env_state, self.obs
+            )
+
+        self.num_timesteps = 0
+        self._vec_steps_since_save = 0
+        self._iteration = jax.jit(self._make_iteration(), donate_argnums=(0, 1))
+
+        self.log_dir = config.log_dir or str(
+            repo_root() / "logs" / config.name
+        )
+
+        if config.resume:
+            self._try_resume()
+
+    # ------------------------------------------------------------------
+    # Functional core
+    # ------------------------------------------------------------------
+
+    def _make_iteration(self):
+        env_params, ppo = self.env_params, self.ppo
+
+        def iteration(
+            train_state: TrainState,
+            env_state,
+            obs: Array,
+            key: Array,
+        ) -> Tuple[TrainState, Any, Array, Array, Dict[str, Array]]:
+            key, k_roll, k_update = jax.random.split(key, 3)
+            env_state, last_obs, batch, last_value = collect_rollout(
+                train_state.apply_fn,
+                train_state.params,
+                env_state,
+                obs,
+                k_roll,
+                env_params,
+                ppo.n_steps,
+            )
+            advantages, returns = compute_gae(
+                batch.rewards,
+                batch.values,
+                batch.dones,
+                last_value,
+                ppo.gamma,
+                ppo.gae_lambda,
+            )
+            flat = MinibatchData(
+                obs=batch.obs.reshape(-1, env_params.obs_dim),
+                actions=batch.actions.reshape(-1, env_params.act_dim),
+                old_log_probs=batch.log_probs.reshape(-1),
+                advantages=advantages.reshape(-1),
+                returns=returns.reshape(-1),
+            )
+            train_state, update_metrics = ppo_update(
+                train_state, flat, k_update, ppo
+            )
+            metrics = {
+                k: v.mean() for k, v in batch.metrics.items()
+            }
+            metrics.update(update_metrics)
+            metrics["reward"] = batch.rewards.mean()
+            metrics["episode_dones"] = batch.dones.sum()
+            return train_state, env_state, last_obs, key, metrics
+
+        return iteration
+
+    # ------------------------------------------------------------------
+    # Imperative shell
+    # ------------------------------------------------------------------
+
+    @property
+    def total_timesteps(self) -> int:
+        if self.config.total_timesteps is not None:
+            return self.config.total_timesteps
+        return 5000 * self.config.num_formations  # vectorized_env.py:116,134
+
+    def run_iteration(self) -> Dict[str, float]:
+        """One rollout + update; returns host-side metric floats."""
+        (
+            self.train_state,
+            self.env_state,
+            self.obs,
+            self.key,
+            metrics,
+        ) = self._iteration(self.train_state, self.env_state, self.obs, self.key)
+        self.num_timesteps += self.ppo.n_steps * self.num_envs
+        self._vec_steps_since_save += self.ppo.n_steps
+        return metrics
+
+    def train(self) -> Dict[str, float]:
+        """Full training run with metrics + checkpoints; returns the last
+        emitted metrics record."""
+        logger = MetricsLogger(
+            self.log_dir,
+            run_name=self.config.name,
+            use_wandb=self.config.use_wandb,
+        )
+        meter = Throughput()
+        last_record: Dict[str, float] = {}
+        iteration = 0
+        try:
+            while self.num_timesteps < self.total_timesteps:
+                metrics = self.run_iteration()
+                iteration += 1
+                meter.tick(self.ppo.n_steps * self.config.num_formations)
+                if iteration % self.config.log_interval == 0:
+                    # One host sync per log interval, after dispatch.
+                    last_record = {
+                        k: float(v) for k, v in metrics.items()
+                    }
+                    last_record["env_steps_per_sec"] = meter.rate()
+                    logger.log(last_record, self.num_timesteps)
+                if (
+                    self.config.checkpoint
+                    and self._vec_steps_since_save >= self.config.save_freq
+                ):
+                    self.save()
+            if self.config.checkpoint:
+                self.save()
+        finally:
+            logger.close()
+        return last_record
+
+    # ------------------------------------------------------------------
+    # Checkpointing (write/read contract: SURVEY.md §5)
+    # ------------------------------------------------------------------
+
+    def _checkpoint_target(self) -> Dict[str, Any]:
+        return {
+            "params": self.train_state.params,
+            "opt_state": self.train_state.opt_state,
+            "key": self.key,
+            "num_timesteps": self.num_timesteps,
+            "env_state": self.env_state,
+            "obs": self.obs,
+        }
+
+    def save(self) -> str:
+        path = save_checkpoint(
+            self.log_dir, self.num_timesteps, self._checkpoint_target()
+        )
+        self._vec_steps_since_save = 0
+        return str(path)
+
+    def _try_resume(self) -> None:
+        path = latest_checkpoint(self.log_dir)
+        if path is None:
+            return
+        restored = restore_checkpoint(path, self._checkpoint_target())
+        self.train_state = self.train_state.replace(
+            params=restored["params"], opt_state=restored["opt_state"]
+        )
+        self.key = restored["key"]
+        self.num_timesteps = int(restored["num_timesteps"])
+        self.env_state = restored["env_state"]
+        self.obs = restored["obs"]
+        if self._shard_fn is not None:
+            # Checkpoints restore as host arrays; re-place them on the mesh
+            # or the resumed run silently trains single-device.
+            self.train_state, self.env_state, self.obs = self._shard_fn(
+                self.train_state, self.env_state, self.obs
+            )
+        print(f"[trainer] resumed from {path} at {self.num_timesteps} steps")

@@ -1,0 +1,21 @@
+"""Config, logging, checkpointing, and profiling utilities."""
+
+from marl_distributedformation_tpu.utils.config import (  # noqa: F401
+    Config,
+    apply_overrides,
+    env_params_from_config,
+    load_config,
+    repo_root,
+)
+from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
+    checkpoint_path,
+    checkpoint_step,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from marl_distributedformation_tpu.utils.logging import MetricsLogger  # noqa: F401
+from marl_distributedformation_tpu.utils.profiling import (  # noqa: F401
+    Throughput,
+    trace,
+)

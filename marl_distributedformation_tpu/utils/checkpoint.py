@@ -1,0 +1,70 @@
+"""Checkpoint save/restore with the reference's discovery contract.
+
+Write path mirrors SB3's ``CheckpointCallback`` naming
+(``rl_model_{num_timesteps}_steps`` under ``logs/{name}/``,
+vectorized_env.py:124); read path mirrors ``visualize_policy.py:31`` — pick
+the file whose step number (``name.split("_")[-2]``) is largest. Unlike the
+reference (which never resumes optimizer state — SURVEY.md §5), checkpoints
+here carry params, optimizer state, and PRNG key, so training resume is
+exact.
+
+Format: flax msgpack serialization of the train-state pytree in a single
+file — host-side, TPU-independent, and restorable on any backend.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any, Optional
+
+from flax import serialization
+
+_STEP_RE = re.compile(r"rl_model_(\d+)_steps")
+
+
+def checkpoint_path(log_dir: str | Path, num_timesteps: int) -> Path:
+    return Path(log_dir) / f"rl_model_{num_timesteps}_steps.msgpack"
+
+
+def save_checkpoint(
+    log_dir: str | Path, num_timesteps: int, target: Any
+) -> Path:
+    """Serialize ``target`` (any pytree) to ``rl_model_{steps}_steps.msgpack``."""
+    path = checkpoint_path(log_dir, num_timesteps)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    # Dot-prefixed temp name so a torn write can never be picked up by
+    # latest_checkpoint (which also filters on the .msgpack suffix).
+    tmp = path.parent / f".{path.name}.tmp"
+    tmp.write_bytes(serialization.to_bytes(target))
+    tmp.replace(path)  # atomic: no torn checkpoints on crash (SURVEY.md §5)
+    return path
+
+
+def latest_checkpoint(log_dir: str | Path) -> Optional[Path]:
+    """Find the checkpoint with the largest step number, exactly like the
+    reference's discovery scan (visualize_policy.py:29-32)."""
+    log_dir = Path(log_dir)
+    if not log_dir.is_dir():
+        return None
+    candidates = [
+        p
+        for p in log_dir.iterdir()
+        if p.suffix == ".msgpack" and _STEP_RE.search(p.name)
+    ]
+    if not candidates:
+        return None
+    return max(candidates, key=lambda p: int(_STEP_RE.search(p.name).group(1)))
+
+
+def restore_checkpoint(path: str | Path, template: Any) -> Any:
+    """Restore a pytree serialized by ``save_checkpoint`` into the structure
+    of ``template`` (same-treedef pytree with correctly-shaped leaves)."""
+    return serialization.from_bytes(template, Path(path).read_bytes())
+
+
+def checkpoint_step(path: str | Path) -> int:
+    m = _STEP_RE.search(Path(path).name)
+    if not m:
+        raise ValueError(f"not a checkpoint path: {path}")
+    return int(m.group(1))

@@ -1,0 +1,122 @@
+"""Hydra-compatible configuration loading.
+
+The reference wires its CLI through ``@hydra.main(config_path="cfg",
+config_name="config")`` with ``key=value`` overrides (vectorized_env.py:112,
+README.md:18). This module preserves that exact CLI contract — ``python
+train.py name=x num_formation=16`` — with a small, dependency-free YAML +
+override parser (hydra itself is not installable in the TPU image;
+SURVEY.md §2.2). Hydra features beyond flat ``key=value``/dotted overrides
+(config groups, ``${...}`` interpolation, multirun) are intentionally out of
+scope: the reference uses none of them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional
+
+import yaml
+
+# Dot-less scientific notation that YAML 1.1 fails to parse as a float.
+_SCI_NOTATION_RE = re.compile(r"^[+-]?\d+(\.\d*)?[eE][+-]?\d+$")
+
+
+class Config(dict):
+    """Dict with attribute access, mirroring omegaconf's DictConfig usage
+    in the reference (``cfg.num_formation`` etc.)."""
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError as e:  # pragma: no cover
+            raise AttributeError(name) from e
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self[name] = value
+
+
+def _parse_value(raw: str) -> Any:
+    """Parse an override value with YAML semantics (hydra behavior):
+    ``true``/``false`` -> bool, numbers -> int/float, ``null`` -> None.
+
+    YAML 1.1 leaves dot-less scientific notation (``3e-4``) as a string;
+    hydra parses it as a float, so coerce exactly that shape — and nothing
+    else, so string-typed values like ``name=2024a`` survive untouched."""
+    value = yaml.safe_load(raw)
+    if isinstance(value, str) and _SCI_NOTATION_RE.match(value):
+        return float(value)
+    return value
+
+
+def apply_overrides(cfg: Dict[str, Any], overrides: Iterable[str]) -> None:
+    """Apply ``key=value`` (dotted keys allowed) overrides in place.
+
+    Unknown top-level keys are accepted, as in hydra's default struct-less
+    mode for this config (the reference's cfg is flat and unvalidated).
+    """
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(
+                f"override {item!r} is not of the form key=value"
+            )
+        key, raw = item.split("=", 1)
+        target = cfg
+        parts = key.split(".")
+        for part in parts[:-1]:
+            # Replace null/scalar intermediates so `mesh.dp=4` works when the
+            # config ships `mesh: null`.
+            if not isinstance(target.get(part), dict):
+                target[part] = Config()
+            target = target[part]
+        target[parts[-1]] = _parse_value(raw)
+
+
+def load_config(
+    overrides: Optional[List[str]] = None,
+    config_path: str = "cfg/config.yaml",
+) -> Config:
+    """Load the YAML config and apply CLI overrides.
+
+    ``config_path`` is resolved relative to the repo root (this file's
+    grandparent), so entry points work from any cwd — the equivalent of the
+    reference's ``hydra.utils.get_original_cwd()`` dance
+    (vectorized_env.py:121)."""
+    path = Path(config_path)
+    if not path.is_absolute() and not path.exists():
+        path = repo_root() / config_path
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    cfg = _to_config(data)
+    apply_overrides(cfg, overrides or [])
+    return cfg
+
+
+def _to_config(data: Any) -> Any:
+    if isinstance(data, dict):
+        return Config({k: _to_config(v) for k, v in data.items()})
+    return data
+
+
+def repo_root() -> Path:
+    """Root of this repository (where ``cfg/`` and ``logs/`` live)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def env_params_from_config(cfg: Config):
+    """Build ``EnvParams`` from the flat config, forwarding every knob —
+    including ``share_reward_ratio``, which the reference silently drops
+    (SURVEY.md Q6)."""
+    from marl_distributedformation_tpu.env import EnvParams
+
+    fields = {f.name for f in dataclasses.fields(EnvParams)}
+    kwargs = {
+        "num_agents": cfg.num_agents_per_formation,
+        "share_reward_ratio": cfg.share_reward_ratio,
+        "goal_in_obs": cfg.goal_in_obs,
+    }
+    for key in fields:
+        if key in cfg and key not in ("num_agents",):
+            kwargs[key] = cfg[key]
+    return EnvParams(**kwargs)

@@ -1,0 +1,75 @@
+"""Metrics logging: on-device accumulation, per-rollout host emission.
+
+Replaces the reference's wandb streaming (SURVEY.md §5): the reference calls
+``wandb.log`` once per formation per step plus 7 times per step from the
+reward/metrics path (Q7 — thousands of network-bound calls per vec-step).
+Here metrics are reduced inside the jitted train step and emitted once per
+rollout to a JSONL file, stdout, and optionally wandb (if installed and
+enabled). Metric names preserve the reference's observability contract
+(``close_to_goal_reward``, ``reward_dist``, ``reward_right_neighbor``,
+``reward_left_neighbor``, ``avg_dist_to_goal``, ``ave_dist_to_neighbor``,
+``std_dist_to_neighbor``, ``reward`` — simulate.py:188-254,
+vectorized_env.py:80-81).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    def __init__(
+        self,
+        log_dir: str | Path,
+        run_name: str = "run",
+        use_wandb: bool = False,
+        wandb_project: str = "formation-rl",
+        stdout_every: int = 10,
+    ) -> None:
+        self.log_dir = Path(log_dir)
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        self.jsonl_path = self.log_dir / "metrics.jsonl"
+        self._file = open(self.jsonl_path, "a", buffering=1)
+        self.stdout_every = stdout_every
+        self._emit_count = 0
+        self._start = time.time()
+
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                # Run naming matches the reference: "{name}-{timestamp}"
+                # (vectorized_env.py:117-118).
+                stamp = time.strftime("%Y-%m-%d-%H-%M")
+                self._wandb = wandb.init(
+                    project=wandb_project, name=f"{run_name}-{stamp}"
+                )
+            except Exception as e:  # pragma: no cover - wandb optional
+                print(f"[metrics] wandb unavailable ({e}); using JSONL only")
+
+    def log(self, metrics: Dict[str, Any], step: int) -> None:
+        """Emit one metrics record at ``step`` (agent-transitions)."""
+        record = {"step": int(step), "time": time.time() - self._start}
+        for k, v in metrics.items():
+            record[k] = float(v)
+        self._file.write(json.dumps(record) + "\n")
+        if self._wandb is not None:
+            self._wandb.log(record, step=int(step))
+        self._emit_count += 1
+        if self.stdout_every and self._emit_count % self.stdout_every == 1:
+            brief = {
+                k: round(record[k], 4)
+                for k in ("reward", "avg_dist_to_goal", "loss", "approx_kl")
+                if k in record
+            }
+            print(f"[metrics] step={record['step']} {brief}", file=sys.stderr)
+
+    def close(self) -> None:
+        self._file.close()
+        if self._wandb is not None:
+            self._wandb.finish()

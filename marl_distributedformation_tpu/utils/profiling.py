@@ -1,0 +1,45 @@
+"""Profiling hooks (the reference has none — SURVEY.md §5).
+
+Thin wrappers over ``jax.profiler`` plus a steps/sec meter, so any training
+run can produce a TensorBoard-loadable TPU trace and throughput numbers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace into ``log_dir`` (no-op if None)."""
+    if log_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Throughput:
+    """Steps/sec meter with warmup exclusion (first call is compile)."""
+
+    def __init__(self) -> None:
+        self._t0: Optional[float] = None
+        self._steps = 0
+
+    def tick(self, steps: int = 1) -> None:
+        if self._t0 is None:  # exclude compile/warmup iteration
+            self._t0 = time.perf_counter()
+            return
+        self._steps += steps
+
+    def rate(self) -> float:
+        if self._t0 is None or self._steps == 0:
+            return 0.0
+        return self._steps / (time.perf_counter() - self._t0)

@@ -1,15 +1,20 @@
 """Test configuration: force JAX onto CPU with 8 virtual devices.
 
-Must run before the first ``import jax`` anywhere in the test session so
-mesh/sharding tests (SURVEY.md §4) can exercise multi-device code paths
-without TPU hardware.
+The container's sitecustomize registers the axon TPU plugin and imports jax
+at interpreter start, so setting ``JAX_PLATFORMS`` here is too late — use
+``jax.config.update`` instead. ``XLA_FLAGS`` still must be set before the
+first backend initialization for the 8 virtual CPU devices (SURVEY.md §4)
+that mesh/sharding tests need.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell exports axon (TPU)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

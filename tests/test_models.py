@@ -1,0 +1,84 @@
+"""Tests for policy networks and the action distribution."""
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from marl_distributedformation_tpu.models import MLPActorCritic, distributions
+
+
+def test_mlp_shapes_and_param_structure():
+    model = MLPActorCritic(act_dim=2)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    mean, log_std, value = model.apply(params, jnp.zeros((7, 8)))
+    chex.assert_shape(mean, (7, 2))
+    chex.assert_shape(log_std, (2,))
+    chex.assert_shape(value, (7,))
+    # Separate pi/vf towers, 2x64, as SB3 'MlpPolicy' builds them.
+    names = set(params["params"].keys())
+    assert names == {"pi_0", "pi_1", "pi_head", "vf_0", "vf_1", "vf_head", "log_std"}
+    assert params["params"]["pi_0"]["kernel"].shape == (8, 64)
+    assert params["params"]["vf_head"]["kernel"].shape == (64, 1)
+
+
+def test_mlp_leading_batch_axes():
+    model = MLPActorCritic(act_dim=2)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    mean, _, value = model.apply(params, jnp.zeros((4, 5, 8)))
+    chex.assert_shape(mean, (4, 5, 2))
+    chex.assert_shape(value, (4, 5))
+
+
+def test_log_std_init_knob():
+    """Q5: log_std_init is a real knob here; parity default is 0.0."""
+    for init in (0.0, -2.0):
+        model = MLPActorCritic(act_dim=2, log_std_init=init)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+        np.testing.assert_allclose(
+            np.asarray(params["params"]["log_std"]), init
+        )
+
+
+def test_orthogonal_init_gains():
+    model = MLPActorCritic(act_dim=2)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    # Hidden kernels: orthogonal with gain sqrt(2) -> columns have norm
+    # sqrt(2) (64x64 square case gives exact orthogonality * gain).
+    k = np.asarray(params["params"]["pi_1"]["kernel"])
+    np.testing.assert_allclose(
+        k.T @ k, 2.0 * np.eye(64), atol=1e-4
+    )
+    # Action head gain 0.01: tiny initial action means.
+    head = np.asarray(params["params"]["pi_head"]["kernel"])
+    assert np.abs(head).max() < 0.01
+
+
+def test_gaussian_log_prob_matches_scipy_formula():
+    key = jax.random.PRNGKey(1)
+    mean = jnp.array([[0.5, -1.0]])
+    log_std = jnp.array([0.3, -0.7])
+    x = jnp.array([[0.1, 0.2]])
+    lp = distributions.log_prob(x, mean, log_std)
+    std = np.exp(np.asarray(log_std))
+    expected = -0.5 * (
+        ((np.asarray(x) - np.asarray(mean)) / std) ** 2
+        + np.log(2 * np.pi)
+    ) - np.log(std)
+    np.testing.assert_allclose(float(lp[0]), expected.sum(), rtol=1e-5)
+
+    # Sampling is reparameterized and respects the std.
+    samples = distributions.sample(
+        key, jnp.zeros((20000, 2)), jnp.log(jnp.array([0.5, 2.0]))
+    )
+    np.testing.assert_allclose(
+        np.asarray(samples).std(axis=0), [0.5, 2.0], rtol=0.05
+    )
+
+
+def test_gaussian_entropy():
+    log_std = jnp.array([0.0, 0.0])
+    expected = 2 * 0.5 * (1 + np.log(2 * np.pi))
+    np.testing.assert_allclose(
+        float(distributions.entropy(log_std)), expected, rtol=1e-6
+    )

@@ -1,0 +1,171 @@
+"""Integration tests: trainer, checkpointing, config, metrics."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from marl_distributedformation_tpu.algo import PPOConfig
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.train import TrainConfig, Trainer
+from marl_distributedformation_tpu.utils import (
+    apply_overrides,
+    checkpoint_step,
+    latest_checkpoint,
+    load_config,
+)
+
+
+def tiny_trainer(tmp_path, **overrides):
+    env_params = EnvParams(num_agents=3)
+    ppo = PPOConfig(n_steps=4, batch_size=24, n_epochs=2)
+    defaults = dict(
+        num_formations=4,
+        total_timesteps=4 * 3 * 4 * 3,  # 3 iterations
+        seed=0,
+        save_freq=8,
+        name="test",
+        log_dir=str(tmp_path / "logs"),
+        log_interval=1,
+    )
+    defaults.update(overrides)
+    return Trainer(env_params, ppo=ppo, config=TrainConfig(**defaults))
+
+
+def test_trainer_runs_and_logs(tmp_path):
+    trainer = tiny_trainer(tmp_path)
+    final = trainer.train()
+    assert trainer.num_timesteps == trainer.total_timesteps
+    assert np.isfinite(final["reward"])
+    assert np.isfinite(final["loss"])
+    # Observability contract metric names (SURVEY.md §5).
+    for name in (
+        "reward",
+        "avg_dist_to_goal",
+        "ave_dist_to_neighbor",
+        "std_dist_to_neighbor",
+        "close_to_goal_reward",
+        "reward_dist",
+        "reward_right_neighbor",
+        "reward_left_neighbor",
+    ):
+        assert name in final, name
+    records = [
+        json.loads(line)
+        for line in (tmp_path / "logs" / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert len(records) == 3
+    assert records[-1]["step"] == trainer.total_timesteps
+
+
+def test_checkpoint_write_discovery_resume(tmp_path):
+    trainer = tiny_trainer(tmp_path)
+    trainer.train()
+    path = latest_checkpoint(tmp_path / "logs")
+    assert path is not None
+    # Naming contract: rl_model_{steps}_steps.* with max-step discovery
+    # (visualize_policy.py:31).
+    assert "rl_model" in path.name
+    assert checkpoint_step(path) == trainer.total_timesteps
+    assert int(path.name.split("_")[-2].split(".")[0]) == trainer.total_timesteps
+
+    # Resume restores params and counters exactly.
+    resumed = tiny_trainer(tmp_path, resume=True)
+    assert resumed.num_timesteps == trainer.total_timesteps
+    a = jax.tree_util.tree_leaves(trainer.train_state.params)
+    b = jax.tree_util.tree_leaves(resumed.train_state.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_trainer_deterministic_under_seed(tmp_path):
+    t1 = tiny_trainer(tmp_path / "a", checkpoint=False)
+    t2 = tiny_trainer(tmp_path / "b", checkpoint=False)
+    m1 = t1.run_iteration()
+    m2 = t2.run_iteration()
+    np.testing.assert_allclose(
+        float(m1["reward"]), float(m2["reward"]), rtol=1e-6
+    )
+    for x, y in zip(
+        jax.tree_util.tree_leaves(t1.train_state.params),
+        jax.tree_util.tree_leaves(t2.train_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+
+
+def test_learning_improves_reward(tmp_path):
+    """PPO on a small problem should beat its initial random policy —
+    the cheap end-to-end learning signal (SURVEY.md §4)."""
+    env_params = EnvParams(num_agents=3, strict_parity=False, max_steps=64)
+    ppo = PPOConfig(n_steps=16, batch_size=192, n_epochs=4)
+    trainer = Trainer(
+        env_params,
+        ppo=ppo,
+        config=TrainConfig(
+            num_formations=16,
+            total_timesteps=16 * 3 * 16 * 40,  # 40 iterations
+            checkpoint=False,
+            name="learn",
+            log_dir=str(tmp_path / "logs"),
+        ),
+    )
+    first = trainer.run_iteration()
+    rewards = []
+    while trainer.num_timesteps < trainer.total_timesteps:
+        rewards.append(float(trainer.run_iteration()["reward"]))
+    late = np.mean(rewards[-5:])
+    assert late > float(first["reward"]) + 1.0, (
+        f"no learning: first={float(first['reward'])}, late={late}"
+    )
+
+
+def test_config_loading_and_overrides(tmp_path):
+    cfg = load_config(["name=x", "num_formation=16", "learning_rate=3e-4"])
+    assert cfg.name == "x"
+    assert cfg.num_formation == 16
+    assert cfg.learning_rate == pytest.approx(3e-4)
+    assert cfg.share_reward_ratio == pytest.approx(0.25)
+    apply_overrides(cfg, ["goal_in_obs=false"])
+    assert cfg.goal_in_obs is False
+    with pytest.raises(ValueError):
+        apply_overrides(cfg, ["oops"])
+
+
+def test_env_params_from_config_forwards_share_ratio():
+    """Q6 fixed: share_reward_ratio flows from cfg to the env."""
+    from marl_distributedformation_tpu.utils import env_params_from_config
+
+    cfg = load_config(["share_reward_ratio=0.4", "num_agents_per_formation=7"])
+    params = env_params_from_config(cfg)
+    assert params.share_reward_ratio == pytest.approx(0.4)
+    assert params.num_agents == 7
+
+
+def test_dotted_override_under_null_key():
+    cfg = load_config(["mesh.dp=4"])
+    assert cfg.mesh == {"dp": 4}
+    # Hydra semantics: numeric-looking values parse as ints; path users
+    # must stringify (train.py does).
+    cfg2 = load_config(["name=2024"])
+    assert str(cfg2.name) == "2024"
+
+
+def test_resume_reapplies_sharding(tmp_path):
+    from marl_distributedformation_tpu.parallel import make_shard_fn
+
+    shard_fn = make_shard_fn({"dp": 8})
+    t1 = tiny_trainer(tmp_path, num_formations=8, total_timesteps=8 * 3 * 4 * 2)
+    t1.train()
+    resumed = Trainer(
+        EnvParams(num_agents=3),
+        ppo=PPOConfig(n_steps=4, batch_size=24, n_epochs=2),
+        config=TrainConfig(
+            num_formations=8,
+            name="test",
+            log_dir=str(tmp_path / "logs"),
+            resume=True,
+        ),
+        shard_fn=shard_fn,
+    )
+    assert not resumed.env_state.agents.sharding.is_fully_replicated

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Training entry point — the reference's ``python vectorized_env.py name=x``
+workflow (reference vectorized_env.py:112-137, README.md:18) on the
+TPU-native backend.
+
+Usage:
+    python train.py name=myrun num_formation=4096 num_agents_per_formation=5
+
+Any key in cfg/config.yaml can be overridden with ``key=value`` (hydra CLI
+contract; hydra itself is optional — see utils/config.py).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from marl_distributedformation_tpu.algo import PPOConfig
+from marl_distributedformation_tpu.train import TrainConfig, Trainer
+from marl_distributedformation_tpu.utils import (
+    env_params_from_config,
+    load_config,
+    repo_root,
+)
+
+
+def build_trainer(cfg) -> Trainer:
+    if cfg.backend != "jax":
+        raise SystemExit(
+            f"backend={cfg.backend!r} is not available in this repo; the "
+            "TPU-native backend is 'jax' (the reference torch/SB3 stack "
+            "lives in the original repository)."
+        )
+    if cfg.get("policy", "mlp") != "mlp":
+        raise SystemExit(
+            f"policy={cfg.policy!r} is not implemented yet; available: mlp"
+        )
+    env_params = env_params_from_config(cfg)
+    ppo = PPOConfig(
+        n_steps=cfg.n_steps,
+        learning_rate=cfg.learning_rate,
+        ent_coef=cfg.ent_coef,
+        gamma=cfg.gamma,
+        gae_lambda=cfg.gae_lambda,
+        clip_range=cfg.clip_range,
+        n_epochs=cfg.n_epochs,
+        batch_size=cfg.batch_size,
+        vf_coef=cfg.vf_coef,
+        max_grad_norm=cfg.max_grad_norm,
+        normalize_advantage=cfg.normalize_advantage,
+        log_std_init=cfg.log_std_init,
+    )
+    run_name = str(cfg.name)  # hydra parses numeric-looking names as ints
+    train_cfg = TrainConfig(
+        num_formations=cfg.num_formation,
+        total_timesteps=cfg.total_timesteps,
+        seed=cfg.seed,
+        save_freq=cfg.save_freq,
+        name=run_name,
+        log_dir=str(repo_root() / "logs" / run_name),
+        use_wandb=cfg.use_wandb,
+        resume=cfg.get("resume", False),
+        log_interval=cfg.log_interval,
+    )
+    shard_fn = None
+    if cfg.get("mesh"):
+        from marl_distributedformation_tpu.parallel import make_shard_fn
+
+        shard_fn = make_shard_fn(dict(cfg.mesh))
+    return Trainer(env_params, ppo=ppo, config=train_cfg, shard_fn=shard_fn)
+
+
+def main(argv=None) -> None:
+    cfg = load_config(sys.argv[1:] if argv is None else argv)
+    if cfg.get("platform"):
+        import jax
+
+        jax.config.update("jax_platforms", cfg.platform)
+    trainer = build_trainer(cfg)
+    print(
+        f"[train] {cfg.name}: M={cfg.num_formation} formations x "
+        f"N={cfg.num_agents_per_formation} agents, "
+        f"{trainer.total_timesteps} agent-transitions, "
+        f"logs -> {trainer.log_dir}"
+    )
+    final = trainer.train()
+    print(f"[train] done at {trainer.num_timesteps} steps: {final}")
+
+
+if __name__ == "__main__":
+    main()
