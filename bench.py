@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Headline benchmark: parallel-formation env throughput on one chip.
+
+Measures env-steps/sec (formation steps per second) for M=4096 parallel
+5-agent formations driven by a uniform random policy inside one jitted
+``lax.scan`` — the BASELINE.json north-star configuration ("4096 parallel
+5-agent formations ... on 1 TPU core"). The reference achieves 1,066
+formation-steps/s at its default M=1000x5 on CPU (BASELINE.md, measured:
+sequential Python loop over torch simulators, vectorized_env.py:71-81);
+``vs_baseline`` is the speedup over that number.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.env.formation import reset_batch, step_batch
+
+REFERENCE_FORMATION_STEPS_PER_SEC = 1066.0  # BASELINE.md, M=1000 x N=5, CPU
+
+M = 4096  # parallel formations (north-star config)
+N = 5  # agents per formation (default cfg)
+CHUNK = 4096  # env steps per scan (amortizes tunnel RTT; see BENCH notes)
+REPEATS = 4  # timed scans
+
+
+def make_runner(params: EnvParams):
+    @jax.jit
+    def run_chunk(state, key):
+        def body(carry, _):
+            state, key = carry
+            key, k_act = jax.random.split(key)
+            # Uniform random policy in [-1, 1], scaled like the adapter
+            # (vectorized_env.py:69-70) — matches how BASELINE.md measured
+            # the reference (env stepping only, no policy inference).
+            actions = jax.random.uniform(
+                k_act, (M, params.num_agents, 2), minval=-1.0, maxval=1.0
+            )
+            state, tr = step_batch(
+                state, params.max_speed * actions, params
+            )
+            return (state, key), tr.reward.mean()
+        (state, key), rewards = jax.lax.scan(
+            body, (state, key), None, length=CHUNK
+        )
+        return state, key, rewards.mean()
+
+    return run_chunk
+
+
+def main() -> None:
+    params = EnvParams(num_agents=N)
+    key = jax.random.PRNGKey(0)
+    state = reset_batch(key, params, M)
+    run_chunk = make_runner(params)
+
+    # Warmup: compile + one execution.
+    state, key, r = run_chunk(state, jax.random.PRNGKey(1))
+    float(r)
+
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        state, key, r = run_chunk(state, key)
+    float(r)  # hard host sync — block_until_ready under-reports on the
+    # experimental axon platform (returns before queued chunks finish)
+    elapsed = time.perf_counter() - t0
+
+    env_steps = M * CHUNK * REPEATS
+    rate = env_steps / elapsed
+    print(
+        f"[bench] device={jax.devices()[0].device_kind} M={M} N={N} "
+        f"steps={env_steps} elapsed={elapsed:.3f}s "
+        f"agent_steps_per_sec={rate * N:.0f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"env_steps_per_sec_{M}x{N}_single_chip",
+                "value": round(rate, 1),
+                "unit": "env-steps/s",
+                "vs_baseline": round(
+                    rate / REFERENCE_FORMATION_STEPS_PER_SEC, 2
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,7 @@ jitted scan — no host round-trips per step.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +46,7 @@ def collect_rollout(
     key: Array,
     env_params: EnvParams,
     n_steps: int,
+    env_step_fn: Optional[Callable] = None,
 ) -> Tuple[FormationState, Array, RolloutBatch, Array]:
     """Roll ``n_steps`` vectorized env steps under the current policy.
 
@@ -54,8 +55,15 @@ def collect_rollout(
     prob go into the buffer), then scaled by ``max_speed`` exactly where the
     reference's adapter does it (vectorized_env.py:69-70).
 
+    ``env_step_fn(state, velocity) -> (state, transition)`` defaults to the
+    vmapped single-chip step; pass a ring step (``parallel.make_ring_step``)
+    to roll with the agent axis sharded over 'sp'.
+
     Returns ``(env_state, last_obs, batch, last_value)``.
     """
+    if env_step_fn is None:
+        def env_step_fn(state, velocity):
+            return step_batch(state, velocity, env_params)
 
     def body(carry, step_key):
         env_state, obs = carry
@@ -63,8 +71,8 @@ def collect_rollout(
         action = distributions.sample(step_key, mean, log_std)
         log_p = distributions.log_prob(action, mean, log_std)
         clipped = jnp.clip(action, -1.0, 1.0)
-        env_state, tr = step_batch(
-            env_state, env_params.max_speed * clipped, env_params
+        env_state, tr = env_step_fn(
+            env_state, env_params.max_speed * clipped
         )
         done_agents = jnp.broadcast_to(
             tr.done[:, None], tr.reward.shape

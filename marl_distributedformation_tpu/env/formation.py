@@ -37,6 +37,36 @@ from marl_distributedformation_tpu.env.types import (
 Array = jax.Array
 
 
+def ring_neighbors(x: Array, axis: int) -> Tuple[Array, Array]:
+    """Default (single-device) ring-neighbor lookup: ``(prev, next)`` along
+    ``axis`` via ``jnp.roll``. The sharded agent-axis variant in
+    ``parallel/ring.py`` swaps this for a ppermute halo exchange; all env
+    math below is parameterized over this function so both paths share one
+    implementation."""
+    return jnp.roll(x, 1, axis=axis), jnp.roll(x, -1, axis=axis)
+
+
+def integrate(
+    agents: Array, velocity: Array, params: EnvParams
+) -> Tuple[Array, Array]:
+    """Single-integrator physics + boundary handling (simulate.py:80-90):
+    returns ``(clipped_agents, out_of_bounds)``. Shape-generic over leading
+    batch axes."""
+    agents = agents + velocity
+    out_of_bounds = (
+        (agents[..., 0] <= 0.0)
+        | (agents[..., 1] <= 0.0)
+        | (agents[..., 0] >= params.width)
+        | (agents[..., 1] >= params.height)
+    )
+    agents = jnp.clip(
+        agents,
+        jnp.zeros((2,), jnp.float32),
+        jnp.array([params.width, params.height], jnp.float32),
+    )
+    return agents, out_of_bounds
+
+
 def reset(key: Array, params: EnvParams) -> FormationState:
     """Sample a fresh formation state.
 
@@ -96,22 +126,32 @@ def reset(key: Array, params: EnvParams) -> FormationState:
 
 
 def compute_obs(
-    agents: Array, goal: Array, params: EnvParams
+    agents: Array,
+    goal: Array,
+    params: EnvParams,
+    pos_neighbors: Tuple[Array, Array] = None,
 ) -> Array:
     """Per-agent local observation (reference simulate.py:150-174).
 
     Layout per agent i: ``[own_pos/WH, prev_i - own, next_i - own,
     (goal - own_pos)/WH?]`` where positions are normalized by (width, height)
     and prev/next are the ring neighbors. The reference's per-agent Python
-    loop becomes two ``jnp.roll``s.
+    loop becomes two ``jnp.roll``s (or, when ``pos_neighbors`` is supplied by
+    the sharded path, a precomputed halo exchange). Shape-generic over
+    leading batch axes (agent axis is -2).
     """
     wh = jnp.array([params.width, params.height], dtype=jnp.float32)
+    if pos_neighbors is None:
+        pos_neighbors = ring_neighbors(agents, -2)
+    prev_pos, next_pos = pos_neighbors
     normalized = agents / wh
-    prev_offset = jnp.roll(normalized, 1, axis=0) - normalized
-    next_offset = jnp.roll(normalized, -1, axis=0) - normalized
-    parts = [normalized, prev_offset, next_offset]
+    parts = [
+        normalized,
+        prev_pos / wh - normalized,
+        next_pos / wh - normalized,
+    ]
     if params.goal_in_obs:
-        parts.append((goal - agents) / wh)  # simulate.py:172
+        parts.append((goal[..., None, :] - agents) / wh)  # simulate.py:172
     return jnp.concatenate(parts, axis=-1)
 
 
@@ -143,21 +183,29 @@ def compute_reward(
     out_of_bounds: Array,
     in_obstacle: Array,
     params: EnvParams,
+    neighbors_fn=ring_neighbors,
+    pos_neighbors: Tuple[Array, Array] = None,
 ) -> Tuple[Array, Dict[str, Array]]:
     """Neighbor-mixed per-agent rewards (reference simulate.py:176-229).
 
-    Returns the mixed rewards ``(N,)`` and the reward-term scalars the
-    reference streams to wandb (simulate.py:188-208), computed on-device.
+    Returns the mixed rewards and a dict of *per-agent* reward-term arrays
+    (the terms the reference streams to wandb, simulate.py:188-208 — callers
+    reduce them: plain ``.mean()`` single-device, psum-mean when the agent
+    axis is sharded). Shape-generic over leading batch axes; ``neighbors_fn``
+    supplies ring neighbors (roll by default, halo exchange when sharded).
     """
-    dist_to_goal = jnp.linalg.norm(agents - goal, axis=-1)
+    dist_to_goal = jnp.linalg.norm(agents - goal[..., None, :], axis=-1)
     close_to_goal = dist_to_goal < params.close_goal_dist
     close_to_goal_reward = params.close_goal_bonus * close_to_goal
     reward_dist = -params.reward_dist_scale * dist_to_goal
 
     # Asymmetric spacing penalty: quadratic when too close, linear when too
     # far (simulate.py:197-205).
-    dist_right = jnp.linalg.norm(agents - jnp.roll(agents, -1, axis=0), axis=-1)
-    dist_left = jnp.linalg.norm(agents - jnp.roll(agents, 1, axis=0), axis=-1)
+    if pos_neighbors is None:
+        pos_neighbors = neighbors_fn(agents, -2)
+    prev_pos, next_pos = pos_neighbors
+    dist_right = jnp.linalg.norm(agents - next_pos, axis=-1)
+    dist_left = jnp.linalg.norm(agents - prev_pos, axis=-1)
     right_diff = dist_right - params.desired_neighbor_dist
     left_diff = dist_left - params.desired_neighbor_dist
     reward_right = -params.neighbor_penalty_scale * jnp.where(
@@ -177,31 +225,35 @@ def compute_reward(
     )
 
     # Ring-neighbor reward mixing (1-2p)*r_i + p*(r_{i-1} + r_{i+1})
-    # (simulate.py:222-229), as rolls instead of a Python loop.
+    # (simulate.py:222-229), as neighbor lookups instead of a Python loop.
     rho = params.share_reward_ratio
-    mixed = (1.0 - 2.0 * rho) * individual + rho * (
-        jnp.roll(individual, 1, axis=0) + jnp.roll(individual, -1, axis=0)
-    )
+    prev_r, next_r = neighbors_fn(individual, -1)
+    mixed = (1.0 - 2.0 * rho) * individual + rho * (prev_r + next_r)
 
-    metrics = {
-        "close_to_goal_reward": close_to_goal_reward.mean(),
-        "reward_dist": reward_dist.mean(),
-        "reward_right_neighbor": reward_right.mean(),
-        "reward_left_neighbor": reward_left.mean(),
+    terms = {
+        "close_to_goal_reward": close_to_goal_reward,
+        "reward_dist": reward_dist,
+        "reward_right_neighbor": reward_right,
+        "reward_left_neighbor": reward_left,
     }
-    return mixed, metrics
+    return mixed, terms
 
 
 def compute_metrics(
-    agents: Array, goal: Array, params: EnvParams
+    agents: Array,
+    goal: Array,
+    params: EnvParams,
+    pos_neighbors: Tuple[Array, Array] = None,
 ) -> Dict[str, Array]:
     """Side-effect-free progress metrics (reference simulate.py:238-254).
 
     ``std_dist_to_neighbor`` uses the unbiased (n-1) estimator to match
     ``torch.Tensor.std``.
     """
-    dist_to_goal = jnp.linalg.norm(agents - goal, axis=-1)
-    dist_right = jnp.linalg.norm(agents - jnp.roll(agents, -1, axis=0), axis=-1)
+    if pos_neighbors is None:
+        pos_neighbors = ring_neighbors(agents, -2)
+    dist_to_goal = jnp.linalg.norm(agents - goal[..., None, :], axis=-1)
+    dist_right = jnp.linalg.norm(agents - pos_neighbors[1], axis=-1)
     return {
         "avg_dist_to_goal": dist_to_goal.mean(),
         "ave_dist_to_neighbor": dist_right.mean(),
@@ -224,23 +276,11 @@ def step(
     timeout check against the pre-increment counter (Q1), auto-reset, then
     metrics and observation on the (possibly reset) state.
     """
-    agents = state.agents + velocity
-
-    out_of_bounds = (
-        (agents[:, 0] <= 0.0)
-        | (agents[:, 1] <= 0.0)
-        | (agents[:, 0] >= params.width)
-        | (agents[:, 1] >= params.height)
-    )
-    agents = jnp.clip(
-        agents,
-        jnp.zeros((2,), jnp.float32),
-        jnp.array([params.width, params.height], jnp.float32),
-    )
+    agents, out_of_bounds = integrate(state.agents, velocity, params)
 
     in_obstacle = _in_obstacle(agents, state.obstacles, params)
 
-    reward, reward_metrics = compute_reward(
+    reward, reward_terms = compute_reward(
         agents, state.goal, out_of_bounds, in_obstacle, params
     )
 
@@ -265,7 +305,7 @@ def step(
 
     obs = compute_obs(next_state.agents, next_state.goal, params)
     metrics = compute_metrics(next_state.agents, next_state.goal, params)
-    metrics.update(reward_metrics)
+    metrics.update({k: v.mean() for k, v in reward_terms.items()})
     metrics["reward"] = reward.mean()
 
     return next_state, Transition(

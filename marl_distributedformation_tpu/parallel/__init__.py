@@ -8,3 +8,7 @@ from marl_distributedformation_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_batch,
 )
+from marl_distributedformation_tpu.parallel.ring import (  # noqa: F401
+    make_ring_step,
+    place_ring_state,
+)

@@ -83,3 +83,74 @@ def test_sharded_env_state_placement(tmp_path):
 def test_indivisible_formations_rejected(tmp_path):
     with pytest.raises(ValueError, match="not divisible"):
         _trainer(tmp_path, shard_fn=make_shard_fn({"dp": 8}), num_formations=12)
+
+
+# ---------------------------------------------------------------------------
+# Ring halo exchange: agent-axis ('sp') sharding (parallel/ring.py)
+# ---------------------------------------------------------------------------
+
+from marl_distributedformation_tpu.env.formation import reset_batch, step_batch
+from marl_distributedformation_tpu.parallel import make_ring_step, place_ring_state
+
+
+@pytest.mark.parametrize("dp,sp", [(1, 8), (2, 4), (4, 2), (8, 1)])
+def test_ring_step_matches_unsharded(dp, sp):
+    """Agent-axis sharding is semantics-free: ring-step trajectories equal
+    the unsharded vmap step exactly (same reset draws, same rewards/obs)."""
+    params = EnvParams(num_agents=8, max_steps=3)  # resets inside the run
+    M = 4 * dp if dp > 1 else 4
+    mesh = make_mesh({"dp": dp, "sp": sp})
+    ring_step = make_ring_step(params, mesh)
+
+    state_ref = reset_batch(jax.random.PRNGKey(0), params, M)
+    state_ring = place_ring_state(state_ref, mesh)
+
+    rng = np.random.default_rng(1)
+    for t in range(8):  # crosses the strict-parity reset at step 5
+        vel = jnp.asarray(
+            rng.uniform(-10, 10, (M, 8, 2)).astype(np.float32)
+        )
+        state_ref, tr_ref = step_batch(state_ref, vel, params)
+        state_ring, tr_ring = ring_step(state_ring, vel)
+        np.testing.assert_allclose(
+            np.asarray(tr_ring.obs), np.asarray(tr_ref.obs),
+            rtol=1e-5, atol=1e-6, err_msg=f"obs t={t}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(tr_ring.reward), np.asarray(tr_ref.reward),
+            rtol=1e-4, atol=1e-4, err_msg=f"reward t={t}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tr_ring.done), np.asarray(tr_ref.done)
+        )
+        np.testing.assert_allclose(
+            np.asarray(state_ring.agents), np.asarray(state_ref.agents),
+            rtol=1e-5, atol=1e-5,
+        )
+        for k in tr_ref.metrics:
+            np.testing.assert_allclose(
+                np.asarray(tr_ring.metrics[k]),
+                np.asarray(tr_ref.metrics[k]),
+                rtol=1e-4, atol=1e-4, err_msg=f"metric {k} t={t}",
+            )
+
+
+def test_ring_step_sharding_layout():
+    params = EnvParams(num_agents=8)
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    ring_step = make_ring_step(params, mesh)
+    state = place_ring_state(
+        reset_batch(jax.random.PRNGKey(0), params, 4), mesh
+    )
+    vel = jnp.zeros((4, 8, 2))
+    state2, tr = ring_step(state, vel)
+    # Agent axis stays sharded over 'sp' after the step.
+    assert not state2.agents.sharding.is_fully_replicated
+    spec = state2.agents.sharding.spec
+    assert tuple(spec)[:2] == ("dp", "sp")
+
+
+def test_ring_step_rejects_indivisible_agents():
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    with pytest.raises(ValueError, match="not divisible"):
+        make_ring_step(EnvParams(num_agents=6), mesh)
