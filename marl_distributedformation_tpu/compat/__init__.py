@@ -1,0 +1,7 @@
+"""Reference-workflow-compatible host-side adapters and frontends."""
+
+from marl_distributedformation_tpu.compat.vec_env import FormationVecEnv  # noqa: F401
+from marl_distributedformation_tpu.compat.policy import (  # noqa: F401
+    LoadedPolicy,
+    load_checkpoint_raw,
+)

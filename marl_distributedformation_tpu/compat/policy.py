@@ -1,0 +1,53 @@
+"""Checkpoint-backed policy for playback — the ``PPO.load`` / ``predict``
+capability the reference gets from SB3 (visualize_policy.py:35,16).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from marl_distributedformation_tpu.models import MLPActorCritic, distributions
+
+
+def load_checkpoint_raw(path: str | Path) -> dict:
+    """Restore a checkpoint file into nested dicts without a template."""
+    return serialization.msgpack_restore(Path(path).read_bytes())
+
+
+class LoadedPolicy:
+    """``predict(obs, deterministic)`` over restored parameters."""
+
+    def __init__(self, params, act_dim: int = 2, seed: int = 0) -> None:
+        self.model = MLPActorCritic(act_dim=act_dim)
+        self.params = params
+        self._key = jax.random.PRNGKey(seed)
+        self._apply = jax.jit(self.model.apply)
+
+    @classmethod
+    def from_checkpoint(cls, path: str | Path, act_dim: int = 2) -> "LoadedPolicy":
+        raw = load_checkpoint_raw(path)
+        if "params" not in raw:
+            raise ValueError(
+                f"{path} does not look like a trainer checkpoint "
+                f"(keys: {sorted(raw)})"
+            )
+        return cls({"params": raw["params"]["params"]}, act_dim=act_dim)
+
+    def predict(
+        self, obs: np.ndarray, deterministic: bool = True
+    ) -> Tuple[np.ndarray, Optional[tuple]]:
+        """SB3 ``predict`` contract: returns ``(actions, state)`` with
+        actions clipped to the [-1, 1] action space."""
+        mean, log_std, _ = self._apply(self.params, jnp.asarray(obs))
+        if deterministic:
+            actions = distributions.mode(mean)
+        else:
+            self._key, k = jax.random.split(self._key)
+            actions = distributions.sample(k, mean, log_std)
+        return np.asarray(jnp.clip(actions, -1.0, 1.0)), None

@@ -1,0 +1,84 @@
+"""Matplotlib renderer for a single formation — the reference's live view
+(simulate.py:33-67): world box, blue agent circles with thin ring edges, red
+goal circle, green obstacle rectangles. Pulls device state to host once per
+frame; rendering never touches the compute path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from marl_distributedformation_tpu.env import EnvParams
+
+
+class FormationRenderer:
+    def __init__(self, params: EnvParams, title: str = "") -> None:
+        import matplotlib.pyplot as plt
+
+        self.params = params
+        self.fig = plt.figure(
+            figsize=(params.width / 100, params.height / 100)
+        )
+        self.ax = self.fig.add_subplot(111)
+        margin = 10  # simulate.py:37
+        self.ax.set_xlim(-margin, params.width + margin)
+        self.ax.set_ylim(-margin, params.height + margin)
+        if title:
+            self.ax.set_title(title)
+        # World boundary (simulate.py:41).
+        self.ax.plot(
+            [0, params.width, params.width, 0, 0],
+            [0, 0, params.height, params.height, 0],
+            color="black",
+        )
+
+        self.agent_circles = []
+        self.agent_lines = []
+        for _ in range(params.num_agents):
+            circle = plt.Circle((0, 0), radius=2, color="blue")
+            self.agent_circles.append(circle)
+            self.ax.add_artist(circle)
+            line = plt.Line2D([0, 0], [0, 0], color="blue", linewidth=0.2)
+            self.agent_lines.append(line)
+            self.ax.add_artist(line)
+
+        self.obstacle_rects = []
+        for _ in range(params.num_obstacles):
+            # Rendered as a 2*obstacle_size box about the obstacle point
+            # (simulate.py:55,129-130) — in "fixed" mode collision matches
+            # this geometry; in "parity" mode it deliberately doesn't (Q2).
+            rect = plt.Rectangle(
+                (0, 0),
+                width=2 * params.obstacle_size,
+                height=2 * params.obstacle_size,
+                color="green",
+            )
+            self.obstacle_rects.append(rect)
+            self.ax.add_artist(rect)
+
+        self.goal_circle = plt.Circle((0, 0), radius=10, color="red")
+        self.ax.add_artist(self.goal_circle)
+
+    def update(
+        self,
+        agents: np.ndarray,
+        goal: np.ndarray,
+        obstacles: Optional[np.ndarray] = None,
+    ) -> None:
+        for pos, circle in zip(agents, self.agent_circles):
+            circle.center = (pos[0], pos[1])
+        ring = np.roll(agents, -1, axis=0)
+        for pos, nxt, line in zip(agents, ring, self.agent_lines):
+            line.set_data([pos[0], nxt[0]], [pos[1], nxt[1]])
+        self.goal_circle.center = (goal[0], goal[1])
+        if obstacles is not None:
+            for pos, rect in zip(obstacles, self.obstacle_rects):
+                rect.xy = (
+                    pos[0] - self.params.obstacle_size,
+                    pos[1] - self.params.obstacle_size,
+                )
+
+    def draw(self) -> None:
+        self.fig.canvas.draw_idle()

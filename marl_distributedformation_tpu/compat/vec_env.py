@@ -1,0 +1,99 @@
+"""Host-side vectorized-env adapter: numpy in, numpy out.
+
+Mirrors the reference's ``FormationEnv`` VecEnv contract
+(vectorized_env.py:16-109) for CPU frontends (playback, teleop): M formations
+x N agents flattened to ``num_envs = M*N`` rows, actions in [-1, 1] scaled by
+``max_speed`` (vectorized_env.py:69-70), ``done``/``infos`` broadcast per
+formation (vectorized_env.py:75-79). The compute path stays the jitted
+functional env; this class only converts at the host boundary.
+
+Unlike the reference, ``seed`` works (SURVEY.md Q9) and ``close`` is a no-op
+instead of raising (Q4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from marl_distributedformation_tpu.env import (
+    EnvParams,
+    action_space,
+    make_vec_env,
+    observation_space,
+)
+
+
+class FormationVecEnv:
+    def __init__(
+        self,
+        params: EnvParams,
+        num_formations: int,
+        seed: int = 0,
+    ) -> None:
+        self.params = params
+        self.num_formations = num_formations
+        self.num_agents = params.num_agents
+        self.num_envs = num_formations * params.num_agents
+        self.observation_space = observation_space(params)
+        self.action_space = action_space(params)
+        self._reset_fn, self._step_fn = make_vec_env(params, num_formations)
+        self._key = jax.random.PRNGKey(seed)
+        self._state = None
+        self.last_metrics: Dict[str, float] = {}
+
+    # -- VecEnv surface (reference vectorized_env.py:52-82) ---------------
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+
+    def reset(self) -> np.ndarray:
+        self._key, k = jax.random.split(self._key)
+        self._state, obs = self._reset_fn(k)
+        return np.asarray(obs).reshape(self.num_envs, -1)
+
+    def step(
+        self, actions: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[dict]]:
+        """``actions``: ``(num_envs, 2)`` in [-1, 1] (policy space)."""
+        assert self._state is not None, "call reset() first"
+        actions = np.asarray(actions, np.float32).reshape(
+            self.num_formations, self.num_agents, 2
+        )
+        self._state, tr = self._step_fn(self._state, jax.numpy.asarray(actions))
+        obs = np.asarray(tr.obs).reshape(self.num_envs, -1)
+        rewards = np.asarray(tr.reward).reshape(self.num_envs)
+        dones = np.repeat(np.asarray(tr.done), self.num_agents)
+        self.last_metrics = {
+            k: float(np.asarray(v).mean()) for k, v in tr.metrics.items()
+        }
+        infos: List[dict] = [{} for _ in range(self.num_envs)]  # Q4 parity
+        return obs, rewards, dones, infos
+
+    def close(self) -> None:
+        pass
+
+    # -- host views for renderers/controllers ------------------------------
+
+    @property
+    def state(self):
+        return self._state
+
+    def agents_np(self, formation: int = 0) -> np.ndarray:
+        return np.asarray(self._state.agents[formation])
+
+    def goal_np(self, formation: int = 0) -> np.ndarray:
+        return np.asarray(self._state.goal[formation])
+
+    def obstacles_np(self, formation: int = 0) -> np.ndarray:
+        return np.asarray(self._state.obstacles[formation])
+
+    def step_velocities(self, velocity: np.ndarray) -> Tuple[Any, ...]:
+        """L0 contract: drive with raw velocities (simulate.py:70), like the
+        reference's teleop/baseline-controller frontends (SURVEY.md Q8)."""
+        return self.step(
+            np.asarray(velocity, np.float32) / self.params.max_speed
+        )

@@ -9,6 +9,8 @@ that mesh/sharding tests need.
 
 import os
 
+os.environ.setdefault("MPLBACKEND", "Agg")  # headless matplotlib for frontends
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Trained-policy playback — the reference's ``python visualize_policy.py
+name=x`` workflow (visualize_policy.py:11-48): discover the newest
+``rl_model_*_steps`` checkpoint under ``logs/{name}/``, load it, run one
+formation with deterministic actions, render and print every transition.
+
+Extras: ``headless=true`` runs without a display, ``steps=N`` limits the
+horizon, ``platform=cpu`` keeps playback off the TPU (recommended — it is a
+single formation).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> None:
+    from marl_distributedformation_tpu.utils import (
+        env_params_from_config,
+        latest_checkpoint,
+        load_config,
+        repo_root,
+    )
+
+    cfg = load_config(sys.argv[1:] if argv is None else argv)
+    if cfg.get("platform"):
+        import jax
+
+        jax.config.update("jax_platforms", cfg.platform)
+
+    from marl_distributedformation_tpu.compat.policy import LoadedPolicy
+    from marl_distributedformation_tpu.compat.vec_env import FormationVecEnv
+
+    checkpoint_dir = repo_root() / "logs" / str(cfg.name)
+    path = latest_checkpoint(checkpoint_dir)
+    if path is None:
+        raise SystemExit(
+            f"no rl_model_*_steps checkpoint found in {checkpoint_dir} — "
+            f"train first: python train.py name={cfg.name}"
+        )
+    print(f"Loading model from {path}")  # visualize_policy.py:33
+    policy = LoadedPolicy.from_checkpoint(path)
+
+    cfg.num_formation = 1  # override, visualize_policy.py:36
+    params = env_params_from_config(cfg)
+    env = FormationVecEnv(params, num_formations=1, seed=cfg.get("seed", 0))
+    obs = env.reset()
+
+    steps = int(cfg.get("steps", 1000))
+    headless = bool(cfg.get("headless", False))
+
+    def playback_step(i, obs):
+        print("-" * 10)
+        print(f"Step {i}")
+        actions, _ = policy.predict(obs, deterministic=True)
+        print(f"actions: {actions}")
+        obs, rewards, dones, _ = env.step(actions)
+        print(f"obs: {obs}")
+        print(f"rewards: {rewards}")
+        print(f"dones: {dones}")
+        return obs
+
+    if headless:
+        for i in range(steps):
+            obs = playback_step(i, obs)
+        return
+
+    import matplotlib.animation as animation
+    import matplotlib.pyplot as plt
+
+    from marl_distributedformation_tpu.compat.render import FormationRenderer
+
+    renderer = FormationRenderer(params, title=f"policy: {path.name}")
+    obs_holder = [obs]
+
+    def frame(i):
+        obs_holder[0] = playback_step(i, obs_holder[0])
+        renderer.update(env.agents_np(), env.goal_np(), env.obstacles_np())
+
+    ani = animation.FuncAnimation(  # noqa: F841
+        renderer.fig, frame, frames=range(steps), interval=200
+    )
+    plt.show()
+
+
+if __name__ == "__main__":
+    main()
