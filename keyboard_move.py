@@ -4,9 +4,9 @@
 move it at speed 10, ESC quits; every transition (action/obs/reward/done/
 info) is printed for human inspection of the env contract (README.md:10-12).
 
-Uses matplotlib's native key events (works in any matplotlib window; no
-global listener thread) and falls back to pynput if requested and installed.
-Extras: ``num_agents=K``, ``platform=cpu``.
+Uses matplotlib's native key events instead of the reference's pynput
+global-listener thread — same keys, no second thread mutating env state
+(SURVEY.md §3.4). Extras: ``num_agents=K``, ``platform=cpu``.
 """
 
 from __future__ import annotations
@@ -17,15 +17,16 @@ import numpy as np
 
 
 def main(argv=None) -> None:
-    from marl_distributedformation_tpu.utils import Config, apply_overrides
+    from marl_distributedformation_tpu.utils import (
+        Config,
+        apply_overrides,
+        setup_platform,
+    )
 
     cfg = Config(num_agents=3, platform=None)
     apply_overrides(cfg, sys.argv[1:] if argv is None else argv)
     num_agents = int(cfg.num_agents)
-    if cfg.platform:
-        import jax
-
-        jax.config.update("jax_platforms", cfg.platform)
+    setup_platform(cfg.platform)
 
     import matplotlib.pyplot as plt
 

@@ -12,7 +12,18 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
-from marl_distributedformation_tpu.models import MLPActorCritic, distributions
+from marl_distributedformation_tpu.models import (
+    CTDEActorCritic,
+    MLPActorCritic,
+    distributions,
+)
+
+# Checkpoints record the policy architecture by class name (trainer
+# ``_checkpoint_target``); this registry maps it back for playback.
+POLICY_REGISTRY = {
+    "MLPActorCritic": MLPActorCritic,
+    "CTDEActorCritic": CTDEActorCritic,
+}
 
 
 def load_checkpoint_raw(path: str | Path) -> dict:
@@ -23,28 +34,63 @@ def load_checkpoint_raw(path: str | Path) -> dict:
 class LoadedPolicy:
     """``predict(obs, deterministic)`` over restored parameters."""
 
-    def __init__(self, params, act_dim: int = 2, seed: int = 0) -> None:
-        self.model = MLPActorCritic(act_dim=act_dim)
+    def __init__(
+        self,
+        params,
+        act_dim: int = 2,
+        seed: int = 0,
+        policy: str = "MLPActorCritic",
+        num_agents: int | None = None,
+    ) -> None:
+        if policy not in POLICY_REGISTRY:
+            raise ValueError(
+                f"unknown policy {policy!r} in checkpoint; known: "
+                f"{sorted(POLICY_REGISTRY)}"
+            )
+        self.model = POLICY_REGISTRY[policy](act_dim=act_dim)
         self.params = params
+        # Formation-level models need the agent axis second-to-last; predict
+        # reshapes flat SB3-style (M*N, obs_dim) inputs using num_agents.
+        self.per_formation = getattr(self.model, "per_formation", False)
+        self.num_agents = num_agents
         self._key = jax.random.PRNGKey(seed)
         self._apply = jax.jit(self.model.apply)
 
     @classmethod
-    def from_checkpoint(cls, path: str | Path, act_dim: int = 2) -> "LoadedPolicy":
+    def from_checkpoint(
+        cls,
+        path: str | Path,
+        act_dim: int = 2,
+        num_agents: int | None = None,
+    ) -> "LoadedPolicy":
         raw = load_checkpoint_raw(path)
         if "params" not in raw:
             raise ValueError(
                 f"{path} does not look like a trainer checkpoint "
                 f"(keys: {sorted(raw)})"
             )
-        return cls({"params": raw["params"]["params"]}, act_dim=act_dim)
+        policy = raw.get("policy", "MLPActorCritic")
+        return cls(
+            {"params": raw["params"]["params"]},
+            act_dim=act_dim,
+            policy=policy,
+            num_agents=num_agents,
+        )
 
     def predict(
         self, obs: np.ndarray, deterministic: bool = True
     ) -> Tuple[np.ndarray, Optional[tuple]]:
         """SB3 ``predict`` contract: returns ``(actions, state)`` with
         actions clipped to the [-1, 1] action space."""
-        mean, log_std, _ = self._apply(self.params, jnp.asarray(obs))
+        obs = jnp.asarray(obs)
+        flat_in = None
+        if self.per_formation and self.num_agents and obs.ndim == 2:
+            # Flat SB3-style (M*N, obs_dim) rows -> (M, N, obs_dim) formations.
+            flat_in = obs.shape
+            obs = obs.reshape(-1, self.num_agents, obs.shape[-1])
+        mean, log_std, _ = self._apply(self.params, obs)
+        if flat_in is not None:
+            mean = mean.reshape(flat_in[0], -1)
         if deterministic:
             actions = distributions.mode(mean)
         else:

@@ -1,4 +1,5 @@
 """Policy/value networks (flax) and action distributions."""
 
 from marl_distributedformation_tpu.models.mlp import MLPActorCritic  # noqa: F401
+from marl_distributedformation_tpu.models.ctde import CTDEActorCritic  # noqa: F401
 from marl_distributedformation_tpu.models import distributions  # noqa: F401

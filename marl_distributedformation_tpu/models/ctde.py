@@ -1,0 +1,111 @@
+"""Centralized-training / decentralized-execution (CTDE) actor-critic.
+
+BASELINE.json config 3: "20-agent formation, per-agent local obs, CTDE
+centralized critic". The reference has no centralized critic — its SB3
+``'MlpPolicy'`` value function sees only one agent's local observation
+(vectorized_env.py:32,126: each agent is its own SB3 "environment") — so
+value estimates cannot account for the other agents' positions even though
+rewards are neighbor-mixed (simulate.py:222-229). This module adds that
+capability the TPU-native way:
+
+- **Actor** — identical per-agent tanh MLP over local observations with
+  shared parameters (decentralized execution: deploying the policy still
+  needs only local information).
+- **Critic** — a permutation-invariant deep-set over the whole formation:
+  per-agent embeddings are mean-pooled into a global formation summary that
+  is concatenated back onto each agent's embedding before the value head.
+  Every tensor op is a batched matmul or reduction along the agent axis, so
+  the whole formation's critic evaluates as a handful of MXU calls — no
+  per-agent loop, any N, one set of weights.
+
+The pooled design (rather than concatenating all N observations into one
+flat critic input, the classic MADDPG layout) keeps the parameter count
+independent of N, stays permutation-equivariant (value_i is invariant to
+re-labeling the *other* agents), and maps onto padding/masking for
+heterogeneous formations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Array = jax.Array
+
+
+class CTDEActorCritic(nn.Module):
+    """Shared per-agent actor + centralized deep-set critic.
+
+    ``__call__`` takes ``obs`` with the agent axis second-to-last —
+    ``(..., N, obs_dim)`` — and returns per-agent ``(mean, log_std, value)``
+    with ``value`` shaped ``(..., N)``. Unlike ``MLPActorCritic`` (which is
+    agent-factored and can be applied to any flattening of agents), this
+    module must see whole formations: the trainer detects ``per_formation``
+    and minibatches over formations instead of agent-transitions.
+
+    ``mask``: optional ``(..., N)`` float/bool validity mask for padded
+    (heterogeneous) formations — masked agents are excluded from the pooled
+    summary and get value 0.
+    """
+
+    act_dim: int = 2
+    hidden: Sequence[int] = (64, 64)
+    embed_dim: int = 64
+    log_std_init: float = 0.0
+    per_formation: bool = True  # trainer flag: minibatch whole formations
+
+    @nn.compact
+    def __call__(
+        self, obs: Array, mask: Optional[Array] = None
+    ) -> Tuple[Array, Array, Array]:
+        hidden_init = nn.initializers.orthogonal(jnp.sqrt(2.0))
+
+        # Actor: per-agent, local-obs only (matches MLPActorCritic's actor
+        # tower so decentralized execution is unchanged).
+        pi = obs
+        for i, width in enumerate(self.hidden):
+            pi = nn.tanh(
+                nn.Dense(width, kernel_init=hidden_init, name=f"pi_{i}")(pi)
+            )
+        mean = nn.Dense(
+            self.act_dim,
+            kernel_init=nn.initializers.orthogonal(0.01),
+            name="pi_head",
+        )(pi)
+
+        # Critic: embed each agent, pool over the agent axis (-2), broadcast
+        # the formation summary back to every agent.
+        emb = nn.tanh(
+            nn.Dense(self.embed_dim, kernel_init=hidden_init, name="vf_embed")(
+                obs
+            )
+        )
+        if mask is not None:
+            m = mask.astype(emb.dtype)[..., None]
+            pooled = (emb * m).sum(axis=-2, keepdims=True) / jnp.maximum(
+                m.sum(axis=-2, keepdims=True), 1.0
+            )
+        else:
+            pooled = emb.mean(axis=-2, keepdims=True)
+        vf = jnp.concatenate(
+            [emb, jnp.broadcast_to(pooled, emb.shape)], axis=-1
+        )
+        for i, width in enumerate(self.hidden):
+            vf = nn.tanh(
+                nn.Dense(width, kernel_init=hidden_init, name=f"vf_{i}")(vf)
+            )
+        value = nn.Dense(
+            1, kernel_init=nn.initializers.orthogonal(1.0), name="vf_head"
+        )(vf).squeeze(-1)
+        if mask is not None:
+            value = value * mask.astype(value.dtype)
+
+        log_std = self.param(
+            "log_std",
+            nn.initializers.constant(self.log_std_init),
+            (self.act_dim,),
+        )
+        return mean, log_std, value

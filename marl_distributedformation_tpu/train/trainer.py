@@ -83,10 +83,19 @@ class Trainer:
         self.model = model or MLPActorCritic(
             act_dim=env_params.act_dim, log_std_init=ppo.log_std_init
         )
+        # Formation-level models (CTDE critic, GNN) must see whole
+        # formations; agent-factored models (plain MLP) can be minibatched
+        # over individual agent-transitions, as SB3 does.
+        self.per_formation = getattr(self.model, "per_formation", False)
 
         key = jax.random.PRNGKey(config.seed)
         self.key, k_init, k_env = jax.random.split(key, 3)
-        dummy_obs = jnp.zeros((1, env_params.obs_dim), jnp.float32)
+        if self.per_formation:
+            dummy_obs = jnp.zeros(
+                (1, env_params.num_agents, env_params.obs_dim), jnp.float32
+            )
+        else:
+            dummy_obs = jnp.zeros((1, env_params.obs_dim), jnp.float32)
         params = self.model.init(k_init, dummy_obs)
         self.train_state = TrainState.create(
             apply_fn=self.model.apply,
@@ -124,6 +133,18 @@ class Trainer:
 
     def _make_iteration(self):
         env_params, ppo = self.env_params, self.ppo
+        if self.per_formation:
+            # Minibatch whole formations: rows are (N, ...) blocks so the
+            # centralized critic sees every agent. batch_size stays denominated
+            # in agent-transitions for comparable SGD noise across policies.
+            n = env_params.num_agents
+            update_ppo = dataclasses.replace(
+                ppo, batch_size=max(1, ppo.batch_size // n)
+            )
+            row_shape = (n,)
+        else:
+            update_ppo = ppo
+            row_shape = ()
 
         def iteration(
             train_state: TrainState,
@@ -150,14 +171,16 @@ class Trainer:
                 ppo.gae_lambda,
             )
             flat = MinibatchData(
-                obs=batch.obs.reshape(-1, env_params.obs_dim),
-                actions=batch.actions.reshape(-1, env_params.act_dim),
-                old_log_probs=batch.log_probs.reshape(-1),
-                advantages=advantages.reshape(-1),
-                returns=returns.reshape(-1),
+                obs=batch.obs.reshape(-1, *row_shape, env_params.obs_dim),
+                actions=batch.actions.reshape(
+                    -1, *row_shape, env_params.act_dim
+                ),
+                old_log_probs=batch.log_probs.reshape(-1, *row_shape),
+                advantages=advantages.reshape(-1, *row_shape),
+                returns=returns.reshape(-1, *row_shape),
             )
             train_state, update_metrics = ppo_update(
-                train_state, flat, k_update, ppo
+                train_state, flat, k_update, update_ppo
             )
             metrics = {
                 k: v.mean() for k, v in batch.metrics.items()
@@ -232,6 +255,7 @@ class Trainer:
 
     def _checkpoint_target(self) -> Dict[str, Any]:
         return {
+            "policy": self.model.__class__.__name__,
             "params": self.train_state.params,
             "opt_state": self.train_state.opt_state,
             "key": self.key,

@@ -6,6 +6,7 @@ from marl_distributedformation_tpu.utils.config import (  # noqa: F401
     env_params_from_config,
     load_config,
     repo_root,
+    setup_platform,
 )
 from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
     checkpoint_path,

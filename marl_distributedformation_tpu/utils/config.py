@@ -99,6 +99,17 @@ def _to_config(data: Any) -> Any:
     return data
 
 
+def setup_platform(platform: Optional[str]) -> None:
+    """Force a JAX backend before first device use (the ``platform=cpu``
+    CLI knob shared by every entry point). ``JAX_PLATFORMS`` env vars are
+    too late under this image's sitecustomize (it imports jax at interpreter
+    start), so this calls ``jax.config.update`` instead. No-op on falsy."""
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
 def repo_root() -> Path:
     """Root of this repository (where ``cfg/`` and ``logs/`` live)."""
     return Path(__file__).resolve().parent.parent.parent

@@ -28,10 +28,11 @@ def main(argv=None) -> None:
     headless = bool(cfg.headless)
     seed = int(cfg.seed)
 
-    import jax
+    from marl_distributedformation_tpu.utils import setup_platform
 
-    if cfg.platform:
-        jax.config.update("jax_platforms", cfg.platform)
+    setup_platform(cfg.platform)
+
+    import jax
 
     from marl_distributedformation_tpu.compat.vec_env import FormationVecEnv
     from marl_distributedformation_tpu.env import EnvParams, control

@@ -1,0 +1,96 @@
+"""CTDE centralized-critic tests (BASELINE.json config 3).
+
+Verifies the defining CTDE property — values are centralized (depend on the
+whole formation) while actions stay decentralized (local obs only) — plus
+mask semantics for padded formations and an end-to-end trainer smoke run at
+20 agents.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from marl_distributedformation_tpu.algo import PPOConfig
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.models import CTDEActorCritic
+from marl_distributedformation_tpu.train import TrainConfig, Trainer
+
+
+def _init(model, n_agents, obs_dim, seed=0):
+    obs = jax.random.normal(
+        jax.random.PRNGKey(seed), (3, n_agents, obs_dim), jnp.float32
+    )
+    params = model.init(jax.random.PRNGKey(1), obs)
+    return params, obs
+
+
+def test_shapes_and_centralization():
+    n, obs_dim = 20, 8
+    model = CTDEActorCritic(act_dim=2)
+    params, obs = _init(model, n, obs_dim)
+    mean, log_std, value = model.apply(params, obs)
+    assert mean.shape == (3, n, 2)
+    assert log_std.shape == (2,)
+    assert value.shape == (3, n)
+
+    # Perturb only agent 7's observation in formation 0.
+    perturbed = obs.at[0, 7].add(0.5)
+    mean2, _, value2 = model.apply(params, perturbed)
+
+    # Decentralized actor: other agents' action means are unchanged.
+    np.testing.assert_allclose(
+        np.delete(np.asarray(mean[0]), 7, axis=0),
+        np.delete(np.asarray(mean2[0]), 7, axis=0),
+        rtol=1e-6,
+    )
+    # Centralized critic: every agent's value in that formation changes.
+    assert (np.abs(np.asarray(value2[0] - value[0])) > 1e-7).all()
+    # Other formations are untouched (no cross-formation leakage).
+    np.testing.assert_allclose(value[1:], value2[1:], rtol=1e-6)
+
+
+def test_permutation_equivariance():
+    n, obs_dim = 6, 8
+    model = CTDEActorCritic(act_dim=2)
+    params, obs = _init(model, n, obs_dim)
+    perm = jnp.array([3, 1, 5, 0, 2, 4])
+    _, _, value = model.apply(params, obs)
+    _, _, value_p = model.apply(params, obs[:, perm])
+    np.testing.assert_allclose(
+        np.asarray(value[:, perm]), np.asarray(value_p), rtol=1e-5
+    )
+
+
+def test_mask_excludes_padded_agents():
+    n, obs_dim = 8, 8
+    model = CTDEActorCritic(act_dim=2)
+    params, obs = _init(model, n, obs_dim)
+    mask = jnp.ones((3, n)).at[:, 5:].set(0.0)
+
+    _, _, value = model.apply(params, obs, mask)
+    # Padded agents report value 0.
+    assert (np.asarray(value[:, 5:]) == 0.0).all()
+
+    # Changing a padded agent's obs must not change active agents' values.
+    perturbed = obs.at[:, 6].add(10.0)
+    _, _, value2 = model.apply(params, perturbed, mask)
+    np.testing.assert_allclose(
+        np.asarray(value[:, :5]), np.asarray(value2[:, :5]), rtol=1e-6
+    )
+
+
+def test_trainer_ctde_20_agents():
+    env_params = EnvParams(num_agents=20)
+    ppo = PPOConfig(n_steps=4, n_epochs=2, batch_size=80)
+    model = CTDEActorCritic(act_dim=env_params.act_dim)
+    trainer = Trainer(
+        env_params,
+        ppo=ppo,
+        config=TrainConfig(num_formations=4, checkpoint=False),
+        model=model,
+    )
+    assert trainer.per_formation
+    metrics = trainer.run_iteration()
+    metrics = trainer.run_iteration()
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["reward"]))

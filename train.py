@@ -20,6 +20,7 @@ from marl_distributedformation_tpu.utils import (
     env_params_from_config,
     load_config,
     repo_root,
+    setup_platform,
 )
 
 
@@ -30,11 +31,19 @@ def build_trainer(cfg) -> Trainer:
             "TPU-native backend is 'jax' (the reference torch/SB3 stack "
             "lives in the original repository)."
         )
-    if cfg.get("policy", "mlp") != "mlp":
-        raise SystemExit(
-            f"policy={cfg.policy!r} is not implemented yet; available: mlp"
-        )
     env_params = env_params_from_config(cfg)
+    policy = cfg.get("policy", "mlp")
+    model = None
+    if policy == "ctde":
+        from marl_distributedformation_tpu.models import CTDEActorCritic
+
+        model = CTDEActorCritic(
+            act_dim=env_params.act_dim, log_std_init=cfg.log_std_init
+        )
+    elif policy != "mlp":
+        raise SystemExit(
+            f"policy={cfg.policy!r} is not implemented; available: mlp, ctde"
+        )
     ppo = PPOConfig(
         n_steps=cfg.n_steps,
         learning_rate=cfg.learning_rate,
@@ -66,15 +75,14 @@ def build_trainer(cfg) -> Trainer:
         from marl_distributedformation_tpu.parallel import make_shard_fn
 
         shard_fn = make_shard_fn(dict(cfg.mesh))
-    return Trainer(env_params, ppo=ppo, config=train_cfg, shard_fn=shard_fn)
+    return Trainer(
+        env_params, ppo=ppo, config=train_cfg, model=model, shard_fn=shard_fn
+    )
 
 
 def main(argv=None) -> None:
     cfg = load_config(sys.argv[1:] if argv is None else argv)
-    if cfg.get("platform"):
-        import jax
-
-        jax.config.update("jax_platforms", cfg.platform)
+    setup_platform(cfg.get("platform"))
     trainer = build_trainer(cfg)
     print(
         f"[train] {cfg.name}: M={cfg.num_formation} formations x "

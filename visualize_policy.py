@@ -22,13 +22,11 @@ def main(argv=None) -> None:
         latest_checkpoint,
         load_config,
         repo_root,
+        setup_platform,
     )
 
     cfg = load_config(sys.argv[1:] if argv is None else argv)
-    if cfg.get("platform"):
-        import jax
-
-        jax.config.update("jax_platforms", cfg.platform)
+    setup_platform(cfg.get("platform"))
 
     from marl_distributedformation_tpu.compat.policy import LoadedPolicy
     from marl_distributedformation_tpu.compat.vec_env import FormationVecEnv
@@ -41,7 +39,9 @@ def main(argv=None) -> None:
             f"train first: python train.py name={cfg.name}"
         )
     print(f"Loading model from {path}")  # visualize_policy.py:33
-    policy = LoadedPolicy.from_checkpoint(path)
+    policy = LoadedPolicy.from_checkpoint(
+        path, num_agents=int(cfg.num_agents_per_formation)
+    )
 
     cfg.num_formation = 1  # override, visualize_policy.py:36
     params = env_params_from_config(cfg)
