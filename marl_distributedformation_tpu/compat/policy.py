@@ -14,6 +14,7 @@ from flax import serialization
 
 from marl_distributedformation_tpu.models import (
     CTDEActorCritic,
+    GNNActorCritic,
     MLPActorCritic,
     distributions,
 )
@@ -23,7 +24,22 @@ from marl_distributedformation_tpu.models import (
 POLICY_REGISTRY = {
     "MLPActorCritic": MLPActorCritic,
     "CTDEActorCritic": CTDEActorCritic,
+    "GNNActorCritic": GNNActorCritic,
 }
+
+
+def model_kwargs_for(policy: str, env_params=None) -> dict:
+    """Extra constructor arguments a policy needs beyond ``act_dim``,
+    derived from the environment configuration (the checkpoint records only
+    the architecture name)."""
+    if policy == "GNNActorCritic":
+        if env_params is None:
+            raise ValueError(
+                "GNNActorCritic playback needs env_params (for knn_k / "
+                "goal_in_obs); pass env_params to from_checkpoint"
+            )
+        return {"k": env_params.knn_k, "goal_in_obs": env_params.goal_in_obs}
+    return {}
 
 
 def load_checkpoint_raw(path: str | Path) -> dict:
@@ -41,13 +57,16 @@ class LoadedPolicy:
         seed: int = 0,
         policy: str = "MLPActorCritic",
         num_agents: int | None = None,
+        model_kwargs: dict | None = None,
     ) -> None:
         if policy not in POLICY_REGISTRY:
             raise ValueError(
                 f"unknown policy {policy!r} in checkpoint; known: "
                 f"{sorted(POLICY_REGISTRY)}"
             )
-        self.model = POLICY_REGISTRY[policy](act_dim=act_dim)
+        self.model = POLICY_REGISTRY[policy](
+            act_dim=act_dim, **(model_kwargs or {})
+        )
         self.params = params
         # Formation-level models need the agent axis second-to-last; predict
         # reshapes flat SB3-style (M*N, obs_dim) inputs using num_agents.
@@ -62,6 +81,7 @@ class LoadedPolicy:
         path: str | Path,
         act_dim: int = 2,
         num_agents: int | None = None,
+        env_params=None,
     ) -> "LoadedPolicy":
         raw = load_checkpoint_raw(path)
         if "params" not in raw:
@@ -70,11 +90,14 @@ class LoadedPolicy:
                 f"(keys: {sorted(raw)})"
             )
         policy = raw.get("policy", "MLPActorCritic")
+        if num_agents is None and env_params is not None:
+            num_agents = env_params.num_agents
         return cls(
             {"params": raw["params"]["params"]},
             act_dim=act_dim,
             policy=policy,
             num_agents=num_agents,
+            model_kwargs=model_kwargs_for(policy, env_params),
         )
 
     def predict(

@@ -26,6 +26,7 @@ from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from marl_distributedformation_tpu.env.types import (
     EnvParams,
@@ -131,15 +132,28 @@ def compute_obs(
     params: EnvParams,
     pos_neighbors: Tuple[Array, Array] = None,
 ) -> Array:
-    """Per-agent local observation (reference simulate.py:150-174).
+    """Per-agent local observation.
 
-    Layout per agent i: ``[own_pos/WH, prev_i - own, next_i - own,
-    (goal - own_pos)/WH?]`` where positions are normalized by (width, height)
-    and prev/next are the ring neighbors. The reference's per-agent Python
-    loop becomes two ``jnp.roll``s (or, when ``pos_neighbors`` is supplied by
-    the sharded path, a precomputed halo exchange). Shape-generic over
-    leading batch axes (agent axis is -2).
+    ``obs_mode="ring"`` (reference simulate.py:150-174) — layout per agent i:
+    ``[own_pos/WH, prev_i - own, next_i - own, (goal - own_pos)/WH?]`` where
+    positions are normalized by (width, height) and prev/next are the ring
+    neighbors. The reference's per-agent Python loop becomes two
+    ``jnp.roll``s (or, when ``pos_neighbors`` is supplied by the sharded
+    path, a precomputed halo exchange). Shape-generic over leading batch
+    axes (agent axis is -2).
+
+    ``obs_mode="knn"`` (BASELINE.json config 4) — see ``compute_obs_knn``.
     """
+    if params.obs_mode == "knn":
+        assert pos_neighbors is None, (
+            "knn obs is incompatible with the ring halo-exchange path; "
+            "shard formations ('dp') only for knn swarms"
+        )
+        if agents.ndim > 2:
+            return jax.vmap(compute_obs, in_axes=(0, 0, None))(
+                agents, goal, params
+            )
+        return compute_obs_knn(agents, goal, params)
     wh = jnp.array([params.width, params.height], dtype=jnp.float32)
     if pos_neighbors is None:
         pos_neighbors = ring_neighbors(agents, -2)
@@ -152,6 +166,33 @@ def compute_obs(
     ]
     if params.goal_in_obs:
         parts.append((goal[..., None, :] - agents) / wh)  # simulate.py:172
+    return jnp.concatenate(parts, axis=-1)
+
+
+def compute_obs_knn(agents: Array, goal: Array, params: EnvParams) -> Array:
+    """Large-swarm observation over the k-nearest-neighbor graph.
+
+    Per agent i: ``[own_pos/WH (2), offsets to k nearest neighbors /WH (2k),
+    distances /diag (k), (goal - own)/WH (2, if goal_in_obs),
+    neighbor indices (k)]``. Indices are exact int values carried in float32
+    (N < 2^24) so formation-level graph models (models/gnn.py) can gather
+    neighbor embeddings for message passing; MLP policies simply learn to
+    ignore them. Single formation ``(N, 2)``; callers ``vmap`` over M.
+    """
+    from marl_distributedformation_tpu.ops import knn
+
+    wh = jnp.array([params.width, params.height], dtype=jnp.float32)
+    diag = float(np.hypot(params.width, params.height))
+    idx, offsets, dists = knn(agents, params.knn_k)
+    n = agents.shape[0]
+    parts = [
+        agents / wh,
+        (offsets / wh).reshape(n, 2 * params.knn_k),
+        dists / diag,
+    ]
+    if params.goal_in_obs:
+        parts.append((goal[None, :] - agents) / wh)
+    parts.append(idx.astype(jnp.float32))
     return jnp.concatenate(parts, axis=-1)
 
 

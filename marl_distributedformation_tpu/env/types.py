@@ -66,6 +66,17 @@ class EnvParams:
     goal. Only honored when ``strict_parity`` is False (the reference ships
     with this disabled)."""
 
+    obs_mode: str = "ring"
+    """``"ring"``: the reference's local view — self + two ring neighbors
+    (+ goal), simulate.py:150-174. ``"knn"``: large-swarm view (BASELINE.json
+    config 4) — self (+ goal) plus offsets/distances/indices of the
+    ``knn_k`` nearest neighbors, recomputed every step (ops/knn.py). Rewards
+    keep ring semantics in both modes (the task definition is the ring
+    formation; only what agents *observe* changes)."""
+
+    knn_k: int = 4
+    """Neighbor count for ``obs_mode="knn"``; must be < num_agents."""
+
     obstacle_mode: str = "parity"
     """``"parity"``: the reference's inconsistent geometry (Q2) — the obstacle
     point is treated as the lower-left corner of an ``obstacle_size``-sided box
@@ -80,6 +91,11 @@ class EnvParams:
             "share_reward_ratio must be in [0, 0.5] (reference simulate.py:28)"
         )
         assert self.obstacle_mode in ("parity", "fixed")
+        assert self.obs_mode in ("ring", "knn")
+        if self.obs_mode == "knn":
+            assert 1 <= self.knn_k < self.num_agents, (
+                f"knn_k={self.knn_k} must be in [1, num_agents)"
+            )
 
     @property
     def desired_neighbor_dist(self) -> float:
@@ -91,8 +107,17 @@ class EnvParams:
 
     @property
     def obs_dim(self) -> int:
-        """Per-agent observation width: 6, +2 when the relative goal is
-        appended (reference vectorized_env.py:28-31)."""
+        """Per-agent observation width.
+
+        ``ring``: 6, +2 when the relative goal is appended (reference
+        vectorized_env.py:28-31). ``knn``: own pos (2) + k offsets (2k) +
+        k distances (k) [+ rel goal (2)] + k neighbor indices (k) — indices
+        ride along as exact-in-float32 values so graph models can gather
+        neighbor embeddings without recomputing the search (models/gnn.py).
+        """
+        if self.obs_mode == "knn":
+            base = 2 + 3 * self.knn_k + (2 if self.goal_in_obs else 0)
+            return base + self.knn_k
         return 8 if self.goal_in_obs else 6
 
     @property

@@ -33,6 +33,12 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from marl_distributedformation_tpu.models.common import (
+    PolicyHead,
+    PooledValueHead,
+    hidden_init,
+)
+
 Array = jax.Array
 
 
@@ -61,20 +67,9 @@ class CTDEActorCritic(nn.Module):
     def __call__(
         self, obs: Array, mask: Optional[Array] = None
     ) -> Tuple[Array, Array, Array]:
-        hidden_init = nn.initializers.orthogonal(jnp.sqrt(2.0))
-
         # Actor: per-agent, local-obs only (matches MLPActorCritic's actor
         # tower so decentralized execution is unchanged).
-        pi = obs
-        for i, width in enumerate(self.hidden):
-            pi = nn.tanh(
-                nn.Dense(width, kernel_init=hidden_init, name=f"pi_{i}")(pi)
-            )
-        mean = nn.Dense(
-            self.act_dim,
-            kernel_init=nn.initializers.orthogonal(0.01),
-            name="pi_head",
-        )(pi)
+        mean = PolicyHead(self.act_dim, self.hidden, name="actor")(obs)
 
         # Critic: embed each agent, pool over the agent axis (-2), broadcast
         # the formation summary back to every agent.
@@ -83,25 +78,7 @@ class CTDEActorCritic(nn.Module):
                 obs
             )
         )
-        if mask is not None:
-            m = mask.astype(emb.dtype)[..., None]
-            pooled = (emb * m).sum(axis=-2, keepdims=True) / jnp.maximum(
-                m.sum(axis=-2, keepdims=True), 1.0
-            )
-        else:
-            pooled = emb.mean(axis=-2, keepdims=True)
-        vf = jnp.concatenate(
-            [emb, jnp.broadcast_to(pooled, emb.shape)], axis=-1
-        )
-        for i, width in enumerate(self.hidden):
-            vf = nn.tanh(
-                nn.Dense(width, kernel_init=hidden_init, name=f"vf_{i}")(vf)
-            )
-        value = nn.Dense(
-            1, kernel_init=nn.initializers.orthogonal(1.0), name="vf_head"
-        )(vf).squeeze(-1)
-        if mask is not None:
-            value = value * mask.astype(value.dtype)
+        value = PooledValueHead(self.hidden, name="critic")(emb, mask)
 
         log_std = self.param(
             "log_std",

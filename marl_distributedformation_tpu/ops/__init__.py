@@ -1,0 +1,6 @@
+"""TPU compute ops: k-NN neighbor search."""
+
+from marl_distributedformation_tpu.ops.knn import (  # noqa: F401
+    knn,
+    pairwise_sq_dists,
+)

@@ -1,0 +1,77 @@
+"""k-nearest-neighbor search over agent positions.
+
+BASELINE.json config 4 ("100-agent swarm with k-nearest-neighbor obs graph
++ GNN policy") needs, per formation and per step, each agent's k nearest
+neighbors. The reference has nothing like it (its interaction graph is the
+static ring, simulate.py:162-167); this op is the new scaling axis for large
+swarms.
+
+TPU mapping: the pairwise squared-distance matrix is computed via the
+expansion |a_i - a_j|^2 = |a_i|^2 + |a_j|^2 - 2 a_i.a_j so the cross term is
+a single (N,2)x(2,N) matmul on the MXU, then ``jax.lax.top_k`` selects the k
+smallest per row. Everything is static-shaped and batches cleanly under
+``vmap`` — at the config-4 scale (M=4096, N=100) the distance matrices are
+~160 MFLOP/step, noise for the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Self-distance mask. Finite (not inf) so top_k never selects NaN garbage
+# even when N <= k would force it into the masked diagonal.
+_SELF_MASK = 1e12
+
+
+def pairwise_sq_dists(points: Array) -> Array:
+    """Squared euclidean distance matrix ``(N, N)`` for ``points (N, d)``,
+    cross term on the MXU; the diagonal is masked to ``_SELF_MASK``."""
+    sq = (points**2).sum(-1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * points @ points.T
+    d2 = jnp.maximum(d2, 0.0)  # clamp catastrophic-cancellation negatives
+    return d2 + _SELF_MASK * jnp.eye(points.shape[0], dtype=points.dtype)
+
+
+def knn(
+    points: Array, k: int, valid: Array = None
+) -> Tuple[Array, Array, Array]:
+    """Per-point k nearest neighbors (excluding self).
+
+    Args:
+      points: ``(N, d)`` positions (single formation; ``vmap`` over M).
+      k: neighbor count, ``k < N``.
+      valid: optional ``(N,)`` bool mask for padded formations — invalid
+        points are never selected as neighbors. When fewer than k valid
+        neighbors exist (a formation padded down to <= k agents), the
+        surplus slots degrade to harmless self-loops: ``idx = i``,
+        ``offset = 0``, ``dist = 0`` — no masked-distance garbage can reach
+        observations.
+
+    Returns:
+      ``(idx, offsets, dists)``: indices ``(N, k)`` int32 sorted by
+      ascending distance, offsets ``(N, k, d)`` with
+      ``offsets[i, j] = points[idx[i, j]] - points[i]``, and euclidean
+      distances ``(N, k)``.
+    """
+    n = points.shape[0]
+    assert k < n, f"knn needs k < N (k={k}, N={n})"
+    d2 = pairwise_sq_dists(points)
+    if valid is not None:
+        d2 = jnp.where(valid[None, :], d2, _SELF_MASK)
+    neg, idx = jax.lax.top_k(-d2, k)
+    idx = idx.astype(jnp.int32)
+    if valid is not None:
+        # Slots that resolved into the masked region (self or invalid
+        # columns, all at _SELF_MASK) become explicit self-loops.
+        real = -neg < 0.5 * _SELF_MASK
+        idx = jnp.where(real, idx, jnp.arange(n, dtype=jnp.int32)[:, None])
+    offsets = points[idx] - points[:, None, :]
+    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    if valid is not None:
+        dists = jnp.where(real, dists, 0.0)
+    return idx, offsets, dists

@@ -72,6 +72,11 @@ def make_ring_step(params: EnvParams, mesh: Mesh):
     outputs P('dp','sp'); per-formation outputs P('dp').
     """
     sp_size = mesh.shape["sp"]
+    if params.obs_mode != "ring":
+        raise ValueError(
+            "agent-axis ('sp') sharding requires obs_mode='ring' — knn "
+            "observations need the whole formation; use 'dp'-only meshes"
+        )
     if params.num_agents % sp_size != 0:
         raise ValueError(
             f"num_agents={params.num_agents} not divisible by sp={sp_size}"

@@ -57,7 +57,7 @@ def test_permutation_equivariance():
     _, _, value = model.apply(params, obs)
     _, _, value_p = model.apply(params, obs[:, perm])
     np.testing.assert_allclose(
-        np.asarray(value[:, perm]), np.asarray(value_p), rtol=1e-5
+        np.asarray(value[:, perm]), np.asarray(value_p), rtol=1e-5, atol=1e-6
     )
 
 

@@ -40,9 +40,24 @@ def build_trainer(cfg) -> Trainer:
         model = CTDEActorCritic(
             act_dim=env_params.act_dim, log_std_init=cfg.log_std_init
         )
+    elif policy == "gnn":
+        if env_params.obs_mode != "knn":
+            raise SystemExit(
+                "policy=gnn needs the k-NN observation graph: set "
+                "obs_mode=knn (and knn_k) in the config"
+            )
+        from marl_distributedformation_tpu.models import GNNActorCritic
+
+        model = GNNActorCritic(
+            k=env_params.knn_k,
+            act_dim=env_params.act_dim,
+            goal_in_obs=env_params.goal_in_obs,
+            log_std_init=cfg.log_std_init,
+        )
     elif policy != "mlp":
         raise SystemExit(
-            f"policy={cfg.policy!r} is not implemented; available: mlp, ctde"
+            f"policy={cfg.policy!r} is not implemented; available: "
+            "mlp, ctde, gnn"
         )
     ppo = PPOConfig(
         n_steps=cfg.n_steps,

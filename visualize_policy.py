@@ -38,13 +38,11 @@ def main(argv=None) -> None:
             f"no rl_model_*_steps checkpoint found in {checkpoint_dir} — "
             f"train first: python train.py name={cfg.name}"
         )
-    print(f"Loading model from {path}")  # visualize_policy.py:33
-    policy = LoadedPolicy.from_checkpoint(
-        path, num_agents=int(cfg.num_agents_per_formation)
-    )
-
     cfg.num_formation = 1  # override, visualize_policy.py:36
     params = env_params_from_config(cfg)
+
+    print(f"Loading model from {path}")  # visualize_policy.py:33
+    policy = LoadedPolicy.from_checkpoint(path, env_params=params)
     env = FormationVecEnv(params, num_formations=1, seed=cfg.get("seed", 0))
     obs = env.reset()
 
