@@ -61,6 +61,17 @@ class MinibatchData:
     old_log_probs: Array  # (b,)
     advantages: Array  # (b,)
     returns: Array  # (b,)
+    weights: Array = None  # (b,) optional per-transition loss weights —
+    #   heterogeneous (padded) formations put weight 0 on padded agents
+    #   (env/hetero.py); None means uniform weights (homogeneous path).
+
+
+def _wmean(x: Array, weights: Array) -> Array:
+    """Weighted mean; with ``weights=None`` falls back to a plain mean."""
+    if weights is None:
+        return x.mean()
+    w = weights.reshape(x.shape if x.ndim else ())
+    return (x * w).sum() / jnp.maximum(w.sum(), 1e-8)
 
 
 def ppo_loss(
@@ -74,21 +85,32 @@ def ppo_loss(
     log_probs = distributions.log_prob(mb.actions, mean, log_std)
     ent = distributions.entropy(log_std)
 
+    w = mb.weights
     advantages = mb.advantages
     if config.normalize_advantage:
-        # SB3 normalizes per minibatch with torch's unbiased std.
-        advantages = (advantages - advantages.mean()) / (
-            advantages.std(ddof=1) + 1e-8
-        )
+        # SB3 normalizes per minibatch with torch's unbiased std. With
+        # weights, moments run over the weighted (active) transitions only.
+        if w is None:
+            advantages = (advantages - advantages.mean()) / (
+                advantages.std(ddof=1) + 1e-8
+            )
+        else:
+            wa = w.reshape(advantages.shape)
+            n_active = jnp.maximum(wa.sum(), 2.0)
+            adv_mean = (advantages * wa).sum() / n_active
+            adv_var = (((advantages - adv_mean) ** 2) * wa).sum() / (
+                n_active - 1.0
+            )
+            advantages = (advantages - adv_mean) / (jnp.sqrt(adv_var) + 1e-8)
 
     ratio = jnp.exp(log_probs - mb.old_log_probs)
     unclipped = advantages * ratio
     clipped = advantages * jnp.clip(
         ratio, 1.0 - config.clip_range, 1.0 + config.clip_range
     )
-    policy_loss = -jnp.minimum(unclipped, clipped).mean()
+    policy_loss = -_wmean(jnp.minimum(unclipped, clipped), w)
 
-    value_loss = jnp.mean((mb.returns - values) ** 2)
+    value_loss = _wmean((mb.returns - values) ** 2, w)
     entropy_loss = -ent  # state-independent Gaussian: scalar
 
     loss = (
@@ -101,9 +123,9 @@ def ppo_loss(
         "policy_loss": policy_loss,
         "value_loss": value_loss,
         "entropy": ent,
-        "approx_kl": jnp.mean(mb.old_log_probs - log_probs),
-        "clip_fraction": jnp.mean(
-            (jnp.abs(ratio - 1.0) > config.clip_range).astype(jnp.float32)
+        "approx_kl": _wmean(mb.old_log_probs - log_probs, w),
+        "clip_fraction": _wmean(
+            (jnp.abs(ratio - 1.0) > config.clip_range).astype(jnp.float32), w
         ),
     }
     return loss, metrics

@@ -22,3 +22,12 @@ from marl_distributedformation_tpu.env.spaces import (  # noqa: F401
     observation_space,
 )
 from marl_distributedformation_tpu.env.baseline import control  # noqa: F401
+from marl_distributedformation_tpu.env.hetero import (  # noqa: F401
+    HeteroState,
+    agent_mask,
+    hetero_reset,
+    hetero_reset_batch,
+    hetero_step,
+    hetero_step_batch,
+    make_hetero_vec_env,
+)

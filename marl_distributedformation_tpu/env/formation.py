@@ -226,6 +226,7 @@ def compute_reward(
     params: EnvParams,
     neighbors_fn=ring_neighbors,
     pos_neighbors: Tuple[Array, Array] = None,
+    neighbor_dist_target: Array = None,
 ) -> Tuple[Array, Dict[str, Array]]:
     """Neighbor-mixed per-agent rewards (reference simulate.py:176-229).
 
@@ -233,7 +234,10 @@ def compute_reward(
     (the terms the reference streams to wandb, simulate.py:188-208 — callers
     reduce them: plain ``.mean()`` single-device, psum-mean when the agent
     axis is sharded). Shape-generic over leading batch axes; ``neighbors_fn``
-    supplies ring neighbors (roll by default, halo exchange when sharded).
+    supplies ring neighbors (roll by default, halo exchange when sharded);
+    ``neighbor_dist_target`` overrides the static regular-polygon chord
+    target — the heterogeneous path (env/hetero.py) passes the per-formation
+    ``2·R·sin(π/n)`` computed from the dynamic agent count.
     """
     dist_to_goal = jnp.linalg.norm(agents - goal[..., None, :], axis=-1)
     close_to_goal = dist_to_goal < params.close_goal_dist
@@ -247,8 +251,13 @@ def compute_reward(
     prev_pos, next_pos = pos_neighbors
     dist_right = jnp.linalg.norm(agents - next_pos, axis=-1)
     dist_left = jnp.linalg.norm(agents - prev_pos, axis=-1)
-    right_diff = dist_right - params.desired_neighbor_dist
-    left_diff = dist_left - params.desired_neighbor_dist
+    target = (
+        params.desired_neighbor_dist
+        if neighbor_dist_target is None
+        else neighbor_dist_target
+    )
+    right_diff = dist_right - target
+    left_diff = dist_left - target
     reward_right = -params.neighbor_penalty_scale * jnp.where(
         right_diff < 0, right_diff**2, right_diff
     )

@@ -1,0 +1,308 @@
+"""Heterogeneous formations: mixed agent counts under XLA static shapes.
+
+BASELINE.json config 5 ("Heterogeneous multi-formation (mixed 5/20-agent
+groups) with obstacle field, curriculum over num_agents_per_formation") has no
+reference implementation — the reference fixes one ``num_agents_per_formation``
+for every formation in the batch (reference ``vectorized_env.py:39-43``) and
+its obstacle system is disabled (``simulate.py:16``; SURVEY.md Q2). This
+module supplies the capability TPU-first:
+
+- Every formation is padded to a static ``params.num_agents`` (= N_max) so one
+  XLA program serves the whole mixed batch; the *active* agent count ``n`` and
+  obstacle count ``k`` are per-formation **data** (int32 scalars in the state
+  pytree), so a curriculum can change the mix between rollouts with zero
+  recompiles.
+- Ring topology, neighbor-spacing targets, and reward mixing all follow the
+  dynamic ``n``: neighbors are gathered with ``(i ± 1) mod n`` index arrays
+  instead of ``jnp.roll``, and the regular-polygon chord target
+  ``2·R·sin(π/n)`` (reference ``simulate.py:26``) is computed per formation.
+- Padded agents are inert: zero observations, zero rewards, zero velocity;
+  they carry zero loss weight in PPO (algo/ppo.py ``MinibatchData.weights``).
+- Inactive obstacle slots are parked far outside the world box so the
+  containment test (formation.py ``_in_obstacle``) can never fire on them.
+
+Single-formation functions take scalars ``n``/``k``; batched wrappers ``vmap``
+over a leading formation axis M exactly like env/formation.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from marl_distributedformation_tpu.env.formation import (
+    _in_obstacle,
+    compute_obs,
+    compute_reward,
+    integrate,
+    reset,
+)
+from marl_distributedformation_tpu.env.types import (
+    EnvParams,
+    Transition,
+    tree_select,
+)
+
+Array = jax.Array
+
+FAR_AWAY = -1.0e6  # parking spot for inactive obstacle slots
+
+
+@struct.dataclass
+class HeteroState:
+    """Per-formation state for a padded heterogeneous formation.
+
+    Same layout as ``FormationState`` (env/types.py) plus the two dynamic
+    counts. ``agents`` is always ``(N_max, 2)``; rows ``>= n_agents`` are
+    padding.
+    """
+
+    agents: Array  # (N_max, 2) float32
+    goal: Array  # (2,) float32
+    obstacles: Array  # (K_max, 2) float32; slots >= n_obstacles parked far away
+    steps: Array  # () int32
+    key: Array  # per-formation PRNG stream
+    n_agents: Array  # () int32 — active agents, 2 <= n <= N_max
+    n_obstacles: Array  # () int32 — active obstacles, 0 <= k <= K_max
+
+
+def agent_mask(n_agents: Array, n_max: int) -> Array:
+    """``(N_max,)`` bool validity mask: True for the first ``n`` slots."""
+    return jnp.arange(n_max) < n_agents
+
+
+def ring_gather_indices(n_agents: Array, n_max: int) -> Tuple[Array, Array]:
+    """Dynamic-ring neighbor indices ``(prev, next)``, each ``(N_max,)``.
+
+    Active agent ``i < n`` has ring neighbors ``(i-1) mod n`` and
+    ``(i+1) mod n`` — the padded replacement for the reference's
+    ``torch.roll`` over a full-length ring (``simulate.py:181-182``).
+    Padded slots produce in-range garbage indices; their outputs are
+    masked by every consumer.
+    """
+    idx = jnp.arange(n_max)
+    prev = (idx - 1 + n_agents) % n_agents
+    nxt = (idx + 1) % n_agents
+    return prev, nxt
+
+
+def desired_neighbor_dist(n_agents: Array, params: EnvParams) -> Array:
+    """Per-formation regular-polygon chord target ``2·R·sin(π/n)``
+    (reference ``simulate.py:26`` with the formation's own ``n``)."""
+    return (
+        2.0
+        * params.desired_radius
+        * jnp.sin(jnp.pi / n_agents.astype(jnp.float32))
+    )
+
+
+def hetero_reset(
+    key: Array, params: EnvParams, n_agents: Array, n_obstacles: Array
+) -> HeteroState:
+    """Sample a fresh padded formation.
+
+    Reuses the homogeneous reset distribution (env/formation.py ``reset``,
+    reference ``simulate.py:120-147``) at the padded sizes, then parks
+    obstacle slots ``>= n_obstacles`` far outside the world so they can never
+    contain an agent. Padded agent rows are sampled like real ones (they are
+    simply never read).
+    """
+    base = reset(key, params)
+    k = jnp.arange(params.num_obstacles) < n_obstacles
+    obstacles = jnp.where(k[:, None], base.obstacles, FAR_AWAY)
+    return HeteroState(
+        agents=base.agents,
+        goal=base.goal,
+        obstacles=obstacles,
+        steps=base.steps,
+        key=base.key,
+        n_agents=jnp.asarray(n_agents, jnp.int32),
+        n_obstacles=jnp.asarray(n_obstacles, jnp.int32),
+    )
+
+
+def hetero_step(
+    state: HeteroState, velocity: Array, params: EnvParams
+) -> Tuple[HeteroState, Transition]:
+    """Advance one padded formation by one step.
+
+    Mirrors the homogeneous step order (env/formation.py ``step``, reference
+    ``simulate.py:70-118``) with the ring re-expressed over the dynamic agent
+    count: integrate → clip/flag bounds → obstacle containment → reward on the
+    dynamic ring → timeout (Q1 semantics under ``strict_parity``) → auto-reset
+    → obs/metrics on the possibly-reset state. Padded agents receive zero
+    velocity, zero reward, zero observation.
+    """
+    assert params.obs_mode == "ring", (
+        "heterogeneous formations use ring obs; knn swarms are homogeneous "
+        "(BASELINE.json configs 4 vs 5)"
+    )
+    n_max = params.num_agents
+    mask = agent_mask(state.n_agents, n_max)
+    prev_idx, next_idx = ring_gather_indices(state.n_agents, n_max)
+
+    def gather_neighbors(x: Array, axis: int) -> Tuple[Array, Array]:
+        del axis  # single formation: agent axis is leading for every consumer
+        return x[prev_idx], x[next_idx]
+
+    velocity = jnp.where(mask[:, None], velocity, 0.0)
+    agents, out_of_bounds = integrate(state.agents, velocity, params)
+    in_obstacle = _in_obstacle(agents, state.obstacles, params)
+
+    pos_neighbors = gather_neighbors(agents, -2)
+    reward, reward_terms = compute_reward(
+        agents,
+        state.goal,
+        out_of_bounds,
+        in_obstacle,
+        params,
+        neighbors_fn=gather_neighbors,
+        pos_neighbors=pos_neighbors,
+        neighbor_dist_target=desired_neighbor_dist(state.n_agents, params),
+    )
+    reward = jnp.where(mask, reward, 0.0)
+
+    if params.strict_parity:
+        done = state.steps > params.max_steps  # Q1 pre-increment check
+    else:
+        done = state.steps + 1 >= params.max_steps
+        if params.goal_termination:
+            dist_to_goal = jnp.linalg.norm(agents - state.goal, axis=-1)
+            close = dist_to_goal < params.close_goal_dist
+            done = done | jnp.where(mask, close, True).all()
+
+    stepped = HeteroState(
+        agents=agents,
+        goal=state.goal,
+        obstacles=state.obstacles,
+        steps=state.steps + 1,
+        key=state.key,
+        n_agents=state.n_agents,
+        n_obstacles=state.n_obstacles,
+    )
+    fresh = hetero_reset(state.key, params, state.n_agents, state.n_obstacles)
+    next_state = tree_select(done, fresh, stepped)
+
+    next_mask = mask  # n_agents is preserved across auto-reset
+    next_prev, next_next = ring_gather_indices(next_state.n_agents, n_max)
+    obs = compute_obs(
+        next_state.agents,
+        next_state.goal,
+        params,
+        pos_neighbors=(
+            next_state.agents[next_prev],
+            next_state.agents[next_next],
+        ),
+    )
+    obs = jnp.where(next_mask[:, None], obs, 0.0)
+
+    fmask = mask.astype(jnp.float32)
+    active = fmask.sum()
+    metrics = hetero_metrics(
+        next_state.agents,
+        next_state.goal,
+        (next_state.agents[next_prev], next_state.agents[next_next]),
+        next_mask,
+    )
+    metrics.update(
+        {k: (v * fmask).sum() / active for k, v in reward_terms.items()}
+    )
+    metrics["reward"] = (reward * fmask).sum() / active
+    metrics["num_active_agents"] = active
+
+    return next_state, Transition(
+        obs=obs, reward=reward, done=done, metrics=metrics
+    )
+
+
+def hetero_metrics(
+    agents: Array,
+    goal: Array,
+    pos_neighbors: Tuple[Array, Array],
+    mask: Array,
+) -> Dict[str, Array]:
+    """Masked progress metrics matching the homogeneous observability
+    contract (env/formation.py ``compute_metrics``, reference
+    ``simulate.py:238-254``); means/std run over active agents only."""
+    fmask = mask.astype(jnp.float32)
+    active = fmask.sum()
+    dist_to_goal = jnp.linalg.norm(agents - goal[None, :], axis=-1)
+    dist_right = jnp.linalg.norm(agents - pos_neighbors[1], axis=-1)
+    mean_right = (dist_right * fmask).sum() / active
+    var_right = (((dist_right - mean_right) ** 2) * fmask).sum() / (
+        active - 1.0
+    )
+    return {
+        "avg_dist_to_goal": (dist_to_goal * fmask).sum() / active,
+        "ave_dist_to_neighbor": mean_right,
+        "std_dist_to_neighbor": jnp.sqrt(var_right),
+    }
+
+
+def hetero_compute_obs(state: HeteroState, params: EnvParams) -> Array:
+    """Masked observation for the current state (reset-time counterpart of
+    the obs computed inside ``hetero_step``)."""
+    n_max = params.num_agents
+    mask = agent_mask(state.n_agents, n_max)
+    prev_idx, next_idx = ring_gather_indices(state.n_agents, n_max)
+    obs = compute_obs(
+        state.agents,
+        state.goal,
+        params,
+        pos_neighbors=(state.agents[prev_idx], state.agents[next_idx]),
+    )
+    return jnp.where(mask[:, None], obs, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Batched (vmapped) wrappers
+# ---------------------------------------------------------------------------
+
+
+def hetero_reset_batch(
+    key: Array, params: EnvParams, n_agents: Array, n_obstacles: Array
+) -> HeteroState:
+    """Reset M formations; ``n_agents``/``n_obstacles`` are ``(M,)`` int32
+    arrays (typically sampled by a curriculum stage, train/curriculum.py)."""
+    keys = jax.random.split(key, n_agents.shape[0])
+    return jax.vmap(hetero_reset, in_axes=(0, None, 0, 0))(
+        keys, params, n_agents, n_obstacles
+    )
+
+
+def hetero_step_batch(
+    state: HeteroState, velocity: Array, params: EnvParams
+) -> Tuple[HeteroState, Transition]:
+    """Step M padded formations; ``velocity`` is ``(M, N_max, 2)``."""
+    return jax.vmap(hetero_step, in_axes=(0, 0, None))(state, velocity, params)
+
+
+def make_hetero_vec_env(
+    params: EnvParams,
+) -> Tuple[Callable, Callable]:
+    """Jitted ``(reset_fn, step_fn)`` with the L1 adapter contract
+    (policy actions in [-1, 1], ``max_speed`` scaling — reference
+    ``vectorized_env.py:68-82``) over padded heterogeneous batches.
+
+    ``reset_fn(key, n_agents, n_obstacles) -> (state, obs)``;
+    ``step_fn(state, actions) -> (state, transition)``.
+    """
+
+    @jax.jit
+    def reset_fn(
+        key: Array, n_agents: Array, n_obstacles: Array
+    ) -> Tuple[HeteroState, Array]:
+        state = hetero_reset_batch(key, params, n_agents, n_obstacles)
+        obs = jax.vmap(hetero_compute_obs, in_axes=(0, None))(state, params)
+        return state, obs
+
+    @jax.jit
+    def step_fn(
+        state: HeteroState, actions: Array
+    ) -> Tuple[HeteroState, Transition]:
+        return hetero_step_batch(state, params.max_speed * actions, params)
+
+    return reset_fn, step_fn

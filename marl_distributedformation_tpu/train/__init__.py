@@ -4,3 +4,10 @@ from marl_distributedformation_tpu.train.trainer import (  # noqa: F401
     TrainConfig,
     Trainer,
 )
+from marl_distributedformation_tpu.train.curriculum import (  # noqa: F401
+    Curriculum,
+    CurriculumStage,
+    HeteroTrainer,
+    curriculum_from_cfg,
+    sample_stage_counts,
+)

@@ -1,0 +1,395 @@
+"""Heterogeneous (padded mixed-N) env + curriculum tests.
+
+Core property: a formation with n active agents padded to N_max must match
+the homogeneous env at num_agents=n exactly — same obs, rewards, done — for
+the active rows, with padding rows inert (zero obs/reward, zero loss weight).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marl_distributedformation_tpu.algo import (
+    MinibatchData,
+    PPOConfig,
+    ppo_loss,
+)
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.env.formation import (
+    compute_obs,
+    reset,
+    step,
+)
+from marl_distributedformation_tpu.env.hetero import (
+    HeteroState,
+    agent_mask,
+    hetero_compute_obs,
+    hetero_reset,
+    hetero_reset_batch,
+    hetero_step,
+    hetero_step_batch,
+    make_hetero_vec_env,
+    ring_gather_indices,
+)
+from marl_distributedformation_tpu.models import MLPActorCritic
+from marl_distributedformation_tpu.train import (
+    Curriculum,
+    CurriculumStage,
+    HeteroTrainer,
+    TrainConfig,
+    sample_stage_counts,
+)
+
+N_MAX = 8
+
+
+def make_padded_state(key, n, params_small, params_padded):
+    """A hetero state whose first n rows equal a homogeneous reset at N=n."""
+    small = reset(key, params_small)
+    pad = jnp.zeros((N_MAX - n, 2), jnp.float32) + 7.0
+    return small, HeteroState(
+        agents=jnp.concatenate([small.agents, pad]),
+        goal=small.goal,
+        obstacles=jnp.zeros((0, 2), jnp.float32),
+        steps=small.steps,
+        key=small.key,
+        n_agents=jnp.asarray(n, jnp.int32),
+        n_obstacles=jnp.asarray(0, jnp.int32),
+    )
+
+
+class TestRingGather:
+    def test_matches_roll_when_full(self):
+        n = jnp.asarray(N_MAX, jnp.int32)
+        prev, nxt = ring_gather_indices(n, N_MAX)
+        idx = np.arange(N_MAX)
+        np.testing.assert_array_equal(np.asarray(prev), (idx - 1) % N_MAX)
+        np.testing.assert_array_equal(np.asarray(nxt), (idx + 1) % N_MAX)
+
+    def test_partial_ring_wraps_at_n(self):
+        prev, nxt = ring_gather_indices(jnp.asarray(5, jnp.int32), N_MAX)
+        assert int(prev[0]) == 4  # agent 0's prev is agent n-1, not N_max-1
+        assert int(nxt[4]) == 0
+        # padded slots still index inside [0, n)
+        assert int(prev[7]) < 5 and int(nxt[7]) < 5
+
+    def test_mask(self):
+        m = agent_mask(jnp.asarray(3, jnp.int32), N_MAX)
+        np.testing.assert_array_equal(
+            np.asarray(m), [True] * 3 + [False] * 5
+        )
+
+
+class TestPaddedEqualsHomogeneous:
+    @pytest.mark.parametrize("n", [2, 5, 8])
+    def test_obs_parity(self, n):
+        params_n = EnvParams(num_agents=n)
+        params_pad = EnvParams(num_agents=N_MAX)
+        small, padded = make_padded_state(
+            jax.random.PRNGKey(0), n, params_n, params_pad
+        )
+        obs_small = compute_obs(small.agents, small.goal, params_n)
+        obs_pad = hetero_compute_obs(padded, params_pad)
+        np.testing.assert_allclose(
+            np.asarray(obs_pad[:n]), np.asarray(obs_small), rtol=1e-6
+        )
+        assert not np.any(np.asarray(obs_pad[n:]))
+
+    @pytest.mark.parametrize("n", [2, 5, 8])
+    def test_step_parity(self, n):
+        params_n = EnvParams(num_agents=n)
+        params_pad = EnvParams(num_agents=N_MAX)
+        small, padded = make_padded_state(
+            jax.random.PRNGKey(1), n, params_n, params_pad
+        )
+        vel = jax.random.normal(jax.random.PRNGKey(2), (n, 2)) * 5.0
+        vel_pad = jnp.concatenate(
+            [vel, jnp.full((N_MAX - n, 2), 123.0)]  # garbage on padded rows
+        )
+        _, tr_small = step(small, vel, params_n)
+        next_pad, tr_pad = hetero_step(padded, vel_pad, params_pad)
+
+        np.testing.assert_allclose(
+            np.asarray(tr_pad.reward[:n]),
+            np.asarray(tr_small.reward),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+        assert not np.any(np.asarray(tr_pad.reward[n:]))
+        np.testing.assert_allclose(
+            np.asarray(tr_pad.obs[:n]),
+            np.asarray(tr_small.obs),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+        assert bool(tr_pad.done) == bool(tr_small.done)
+        # padded agents must not have moved (zero-velocity mask)
+        np.testing.assert_allclose(
+            np.asarray(next_pad.agents[n:]), np.asarray(padded.agents[n:])
+        )
+        # metrics reduce over active agents only
+        for k in ("avg_dist_to_goal", "ave_dist_to_neighbor"):
+            np.testing.assert_allclose(
+                float(tr_pad.metrics[k]),
+                float(tr_small.metrics[k]),
+                rtol=1e-5,
+            )
+
+    def test_dynamic_spacing_target(self):
+        """The spacing penalty must use 2*R*sin(pi/n) for the formation's own
+        n, not N_max's chord."""
+        n = 4
+        params_n = EnvParams(num_agents=n)
+        params_pad = EnvParams(num_agents=N_MAX)
+        assert params_n.desired_neighbor_dist != pytest.approx(
+            params_pad.desired_neighbor_dist
+        )
+        small, padded = make_padded_state(
+            jax.random.PRNGKey(3), n, params_n, params_pad
+        )
+        _, tr_small = step(small, jnp.zeros((n, 2)), params_n)
+        _, tr_pad = hetero_step(padded, jnp.zeros((N_MAX, 2)), params_pad)
+        np.testing.assert_allclose(
+            np.asarray(tr_pad.reward[:n]),
+            np.asarray(tr_small.reward),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+
+
+class TestAutoResetAndObstacles:
+    def test_auto_reset_preserves_counts(self):
+        params = EnvParams(num_agents=N_MAX, num_obstacles=4)
+        state = hetero_reset(
+            jax.random.PRNGKey(0),
+            params,
+            jnp.asarray(5, jnp.int32),
+            jnp.asarray(2, jnp.int32),
+        )
+        state = dataclasses.replace(
+            state, steps=jnp.asarray(params.max_steps + 1, jnp.int32)
+        )
+        next_state, tr = hetero_step(state, jnp.zeros((N_MAX, 2)), params)
+        assert bool(tr.done)
+        assert int(next_state.steps) == 0
+        assert int(next_state.n_agents) == 5
+        assert int(next_state.n_obstacles) == 2
+
+    def test_inactive_obstacles_never_collide(self):
+        params = EnvParams(num_agents=4, num_obstacles=3)
+        state = hetero_reset(
+            jax.random.PRNGKey(1),
+            params,
+            jnp.asarray(4, jnp.int32),
+            jnp.asarray(0, jnp.int32),  # all obstacle slots inactive
+        )
+        obstacles = np.asarray(state.obstacles)
+        assert (obstacles < -1e5).all()  # parked far outside the world
+        _, tr = hetero_step(state, jnp.zeros((4, 2)), params)
+        # no obstacle penalty possible: rewards bounded below by other terms
+        assert np.asarray(tr.reward).min() > -params.obstacle_penalty
+
+    def test_active_obstacle_penalizes(self):
+        params = EnvParams(
+            num_agents=4, num_obstacles=1, obstacle_mode="fixed"
+        )
+        state = hetero_reset(
+            jax.random.PRNGKey(2),
+            params,
+            jnp.asarray(4, jnp.int32),
+            jnp.asarray(1, jnp.int32),
+        )
+        # drop agent 0 onto the obstacle center
+        agents = state.agents.at[0].set(state.obstacles[0])
+        state = dataclasses.replace(state, agents=agents)
+        _, tr = hetero_step(state, jnp.zeros((4, 2)), params)
+        assert float(tr.reward[0]) < -50.0  # obstacle penalty dominates
+
+
+class TestWeightedPPO:
+    def test_zero_weight_rows_do_not_change_loss(self):
+        key = jax.random.PRNGKey(0)
+        model = MLPActorCritic(act_dim=2)
+        params = model.init(key, jnp.zeros((1, 8)))
+        cfg = PPOConfig()
+
+        b = 32
+        obs = jax.random.normal(key, (b, 8))
+        act = jax.random.normal(jax.random.PRNGKey(1), (b, 2))
+        lp = jax.random.normal(jax.random.PRNGKey(2), (b,))
+        adv = jax.random.normal(jax.random.PRNGKey(3), (b,))
+        ret = jax.random.normal(jax.random.PRNGKey(4), (b,))
+
+        active = MinibatchData(
+            obs=obs, actions=act, old_log_probs=lp, advantages=adv,
+            returns=ret, weights=jnp.ones((b,)),
+        )
+        # corrupt half the rows, weight them zero
+        junk = 1e3
+        padded = MinibatchData(
+            obs=jnp.concatenate([obs, obs + junk]),
+            actions=jnp.concatenate([act, act - junk]),
+            old_log_probs=jnp.concatenate([lp, lp + junk]),
+            advantages=jnp.concatenate([adv, adv * junk]),
+            returns=jnp.concatenate([ret, ret - junk]),
+            weights=jnp.concatenate([jnp.ones((b,)), jnp.zeros((b,))]),
+        )
+        loss_a, _ = ppo_loss(params, model.apply, active, cfg)
+        loss_p, _ = ppo_loss(params, model.apply, padded, cfg)
+        np.testing.assert_allclose(
+            float(loss_a), float(loss_p), rtol=1e-5
+        )
+
+    def test_none_weights_matches_uniform(self):
+        key = jax.random.PRNGKey(5)
+        model = MLPActorCritic(act_dim=2)
+        params = model.init(key, jnp.zeros((1, 8)))
+        cfg = PPOConfig()
+        b = 16
+        data = dict(
+            obs=jax.random.normal(key, (b, 8)),
+            actions=jax.random.normal(key, (b, 2)),
+            old_log_probs=jax.random.normal(key, (b,)),
+            advantages=jax.random.normal(key, (b,)),
+            returns=jax.random.normal(key, (b,)),
+        )
+        loss_none, _ = ppo_loss(
+            params, model.apply, MinibatchData(**data), cfg
+        )
+        loss_ones, _ = ppo_loss(
+            params,
+            model.apply,
+            MinibatchData(**data, weights=jnp.ones((b,))),
+            cfg,
+        )
+        np.testing.assert_allclose(
+            float(loss_none), float(loss_ones), rtol=1e-5
+        )
+
+
+class TestCurriculum:
+    def test_sample_stage_counts(self):
+        stage = CurriculumStage(
+            rollouts=1, agent_counts=(5, 20), num_obstacles=3
+        )
+        n_agents, n_obstacles = sample_stage_counts(
+            jax.random.PRNGKey(0), stage, 256
+        )
+        vals = set(np.asarray(n_agents).tolist())
+        assert vals == {5, 20}
+        assert (np.asarray(n_obstacles) == 3).all()
+
+    def test_probs_respected(self):
+        stage = CurriculumStage(
+            rollouts=1, agent_counts=(5, 20), probs=(1.0, 0.0)
+        )
+        n_agents, _ = sample_stage_counts(jax.random.PRNGKey(1), stage, 64)
+        assert (np.asarray(n_agents) == 5).all()
+
+    def test_curriculum_maxima(self):
+        cur = Curriculum()
+        assert cur.max_agents == 20
+        assert cur.max_obstacles == 4
+        assert cur.total_rollouts == 100
+
+    def test_vec_env_mixed_batch(self):
+        params = EnvParams(num_agents=20, num_obstacles=4)
+        reset_fn, step_fn = make_hetero_vec_env(params)
+        n_agents = jnp.asarray([5, 20, 7, 2], jnp.int32)
+        n_obstacles = jnp.asarray([0, 4, 2, 0], jnp.int32)
+        state, obs = reset_fn(jax.random.PRNGKey(0), n_agents, n_obstacles)
+        assert obs.shape == (4, 20, params.obs_dim)
+        actions = jax.random.uniform(
+            jax.random.PRNGKey(1), (4, 20, 2), minval=-1.0, maxval=1.0
+        )
+        state, tr = step_fn(state, actions)
+        assert tr.reward.shape == (4, 20)
+        # padding rows of formation 0 (n=5) inert
+        assert not np.any(np.asarray(tr.reward[0, 5:]))
+        assert np.isfinite(np.asarray(tr.reward)).all()
+
+
+class TestHeteroTrainer:
+    def test_short_curriculum_run(self, tmp_path):
+        cur = Curriculum(
+            stages=(
+                CurriculumStage(rollouts=2, agent_counts=(3,)),
+                CurriculumStage(
+                    rollouts=2, agent_counts=(3, 6), num_obstacles=2
+                ),
+            )
+        )
+        ppo = PPOConfig(n_steps=4, n_epochs=2, batch_size=32)
+        trainer = HeteroTrainer(
+            curriculum=cur,
+            env_params=EnvParams(num_agents=3, max_steps=16),
+            ppo=ppo,
+            config=TrainConfig(
+                num_formations=8,
+                name="hetero-test",
+                log_dir=str(tmp_path),
+                save_freq=10_000,
+                use_wandb=False,
+            ),
+        )
+        assert trainer.env_params.num_agents == 6
+        assert trainer.env_params.num_obstacles == 2
+        record = trainer.train()
+        assert np.isfinite(record["loss"])
+        assert np.isfinite(record["reward"])
+        assert record["curriculum_stage"] == 1.0
+        # active-agent timestep accounting: stage rollouts * n_steps * sum(n)
+        assert trainer.num_timesteps > 0
+
+    def test_resume_skips_completed_stages(self, tmp_path):
+        cur = Curriculum(
+            stages=(
+                CurriculumStage(rollouts=2, agent_counts=(3,)),
+                CurriculumStage(rollouts=2, agent_counts=(4,)),
+            )
+        )
+        kwargs = dict(
+            curriculum=cur,
+            env_params=EnvParams(num_agents=4, max_steps=16),
+            ppo=PPOConfig(n_steps=2, n_epochs=1, batch_size=16),
+        )
+        config = TrainConfig(
+            num_formations=4,
+            name="hetero-resume",
+            log_dir=str(tmp_path),
+            save_freq=10_000,
+            use_wandb=False,
+        )
+        first = HeteroTrainer(config=config, **kwargs)
+        first.start_stage(cur.stages[0])
+        first.run_iteration()
+        first.run_iteration()
+        first.completed_rollouts = 2  # stage 0 done
+        first.save()
+
+        resumed = HeteroTrainer(
+            config=dataclasses.replace(config, resume=True), **kwargs
+        )
+        assert resumed.completed_rollouts == 2
+        record = resumed.train()
+        # only stage 1 ran: 2 rollouts * 2 n_steps * 4 formations * 4 agents
+        assert resumed.completed_rollouts == 4
+        assert (
+            resumed.num_timesteps
+            == first.num_timesteps + 2 * 2 * 4 * 4
+        )
+        assert record["curriculum_stage"] == 1.0
+
+    def test_curriculum_from_cfg_parses_yaml_string(self):
+        from marl_distributedformation_tpu.train import curriculum_from_cfg
+
+        cur = curriculum_from_cfg(
+            "[{rollouts: 4, agent_counts: [5]}, "
+            "{rollouts: 2, agent_counts: [5, 20], num_obstacles: 4}]"
+        )
+        assert cur.total_rollouts == 6
+        assert cur.max_agents == 20
+        assert cur.max_obstacles == 4

@@ -32,6 +32,8 @@ def build_trainer(cfg) -> Trainer:
             "lives in the original repository)."
         )
     env_params = env_params_from_config(cfg)
+    if cfg.get("curriculum"):
+        return build_hetero_trainer(cfg, env_params)
     policy = cfg.get("policy", "mlp")
     model = None
     if policy == "ctde":
@@ -92,6 +94,54 @@ def build_trainer(cfg) -> Trainer:
         shard_fn = make_shard_fn(dict(cfg.mesh))
     return Trainer(
         env_params, ppo=ppo, config=train_cfg, model=model, shard_fn=shard_fn
+    )
+
+
+def build_hetero_trainer(cfg, env_params):
+    """Curriculum path (BASELINE.json config 5): mixed-size padded formations
+    with an obstacle field, staged over ``cfg.curriculum``."""
+    from marl_distributedformation_tpu.train import (
+        HeteroTrainer,
+        curriculum_from_cfg,
+    )
+
+    if cfg.get("policy", "mlp") != "mlp":
+        raise SystemExit(
+            "curriculum training uses the shared per-agent MLP policy "
+            "(padded agents are masked per transition); set policy=mlp"
+        )
+    curriculum = curriculum_from_cfg(cfg.curriculum)
+    ppo = PPOConfig(
+        n_steps=cfg.n_steps,
+        learning_rate=cfg.learning_rate,
+        ent_coef=cfg.ent_coef,
+        gamma=cfg.gamma,
+        gae_lambda=cfg.gae_lambda,
+        clip_range=cfg.clip_range,
+        n_epochs=cfg.n_epochs,
+        batch_size=cfg.batch_size,
+        vf_coef=cfg.vf_coef,
+        max_grad_norm=cfg.max_grad_norm,
+        normalize_advantage=cfg.normalize_advantage,
+        log_std_init=cfg.log_std_init,
+    )
+    run_name = str(cfg.name)
+    train_cfg = TrainConfig(
+        num_formations=cfg.num_formation,
+        total_timesteps=cfg.total_timesteps,
+        seed=cfg.seed,
+        save_freq=cfg.save_freq,
+        name=run_name,
+        log_dir=str(repo_root() / "logs" / run_name),
+        use_wandb=cfg.use_wandb,
+        resume=cfg.get("resume", False),
+        log_interval=cfg.log_interval,
+    )
+    return HeteroTrainer(
+        curriculum=curriculum,
+        env_params=env_params,
+        ppo=ppo,
+        config=train_cfg,
     )
 
 
