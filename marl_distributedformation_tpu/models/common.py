@@ -15,7 +15,11 @@ from flax import linen as nn
 
 Array = jax.Array
 
-hidden_init = nn.initializers.orthogonal(jnp.sqrt(2.0))
+# Host-side sqrt: jnp.sqrt here would run a device computation at import
+# time, initializing the JAX backend before entry points can pick a platform
+# (utils/config.py setup_platform) — on this image that means a TPU-tunnel
+# roundtrip just to import the package.
+hidden_init = nn.initializers.orthogonal(2.0**0.5)
 
 
 def masked_mean_pool(x: Array, mask: Optional[Array]) -> Array:

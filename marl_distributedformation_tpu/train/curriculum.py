@@ -145,6 +145,7 @@ class HeteroTrainer:
         env_params: Optional[EnvParams] = None,
         ppo: PPOConfig = PPOConfig(),
         config: TrainConfig = TrainConfig(),
+        shard_fn: Any = None,
     ) -> None:
         self.curriculum = curriculum
         if env_params is None:
@@ -172,6 +173,7 @@ class HeteroTrainer:
             tx=ppo.make_optimizer(),
         )
 
+        self._shard_fn = shard_fn
         self.env_state: Optional[HeteroState] = None
         self.obs: Optional[Array] = None
         self.num_timesteps = 0
@@ -246,7 +248,11 @@ class HeteroTrainer:
             metrics.update(update_metrics)
             w = jnp.maximum(weights.sum(), 1.0)
             metrics["reward"] = (batch.rewards.reshape(-1) * weights).sum() / w
-            metrics["episode_dones"] = batch.dones.sum()
+            # Formation-level episode count: batch.dones is the per-formation
+            # done broadcast to all N_max agent rows (rollout.py), so a plain
+            # sum counts every padded row, inflating the count x N_max.
+            # Agent row 0 is always active (n >= 2).
+            metrics["episode_dones"] = batch.dones[..., 0].sum()
             return train_state, env_state, last_obs, key, metrics
 
         return iteration
@@ -282,6 +288,13 @@ class HeteroTrainer:
         self.obs = jax.vmap(hetero_compute_obs, in_axes=(0, None))(
             self.env_state, self.env_params
         )
+        if self._shard_fn is not None:
+            # Every stage builds a fresh env state on the host; re-place it
+            # (and keep params replicated) on the mesh. This also covers
+            # resume, since start_stage always precedes run_iteration.
+            self.train_state, self.env_state, self.obs = self._shard_fn(
+                self.train_state, self.env_state, self.obs
+            )
         self._active_agents = int(n_agents.sum())
 
     def run_iteration(self) -> Dict[str, Array]:
@@ -296,6 +309,7 @@ class HeteroTrainer:
             self.train_state, self.env_state, self.obs, self.key
         )
         self.num_timesteps += self.ppo.n_steps * self._active_agents
+        self.completed_rollouts += 1
         self._vec_steps_since_save += self.ppo.n_steps
         return metrics
 
@@ -330,7 +344,6 @@ class HeteroTrainer:
                         done_budget = True
                         break
                     metrics = self.run_iteration()
-                    self.completed_rollouts += 1
                     iteration += 1
                     meter.tick(
                         self.ppo.n_steps * self.config.num_formations

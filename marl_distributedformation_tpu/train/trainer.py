@@ -187,7 +187,10 @@ class Trainer:
             }
             metrics.update(update_metrics)
             metrics["reward"] = batch.rewards.mean()
-            metrics["episode_dones"] = batch.dones.sum()
+            # Formation-level episode count (batch.dones broadcasts the
+            # per-formation done to all N agent rows; same reduction as
+            # HeteroTrainer so the metric's unit matches across trainers).
+            metrics["episode_dones"] = batch.dones[..., 0].sum()
             return train_state, env_state, last_obs, key, metrics
 
         return iteration

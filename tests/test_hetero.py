@@ -367,7 +367,7 @@ class TestHeteroTrainer:
         first.start_stage(cur.stages[0])
         first.run_iteration()
         first.run_iteration()
-        first.completed_rollouts = 2  # stage 0 done
+        assert first.completed_rollouts == 2  # stage 0 done
         first.save()
 
         resumed = HeteroTrainer(
@@ -382,6 +382,42 @@ class TestHeteroTrainer:
             == first.num_timesteps + 2 * 2 * 4 * 4
         )
         assert record["curriculum_stage"] == 1.0
+
+    def test_sharded_hetero_trainer(self, tmp_path):
+        """Curriculum training with the formation axis sharded over 'dp'
+        (the cfg.mesh path): stage transitions must re-place the fresh env
+        state on the mesh and the run must stay finite."""
+        from marl_distributedformation_tpu.parallel import make_shard_fn
+
+        shard_fn = make_shard_fn({"dp": 4})
+        cur = Curriculum(
+            stages=(
+                CurriculumStage(rollouts=2, agent_counts=(3,)),
+                CurriculumStage(rollouts=2, agent_counts=(3, 4)),
+            )
+        )
+        trainer = HeteroTrainer(
+            curriculum=cur,
+            env_params=EnvParams(num_agents=4, max_steps=16),
+            ppo=PPOConfig(n_steps=2, n_epochs=1, batch_size=16),
+            config=TrainConfig(
+                num_formations=8,
+                name="hetero-sharded",
+                log_dir=str(tmp_path),
+                save_freq=10_000,
+                use_wandb=False,
+            ),
+            shard_fn=shard_fn,
+        )
+        trainer.start_stage(cur.stages[0])
+        sharding = trainer.obs.sharding
+        assert sharding.is_equivalent_to(
+            jax.NamedSharding(shard_fn.mesh, jax.sharding.PartitionSpec("dp")),
+            trainer.obs.ndim,
+        )
+        record = trainer.train()
+        assert np.isfinite(record["loss"])
+        assert trainer.completed_rollouts == 4
 
     def test_curriculum_from_cfg_parses_yaml_string(self):
         from marl_distributedformation_tpu.train import curriculum_from_cfg

@@ -24,6 +24,46 @@ from marl_distributedformation_tpu.utils import (
 )
 
 
+def ppo_from_config(cfg) -> PPOConfig:
+    return PPOConfig(
+        n_steps=cfg.n_steps,
+        learning_rate=cfg.learning_rate,
+        ent_coef=cfg.ent_coef,
+        gamma=cfg.gamma,
+        gae_lambda=cfg.gae_lambda,
+        clip_range=cfg.clip_range,
+        n_epochs=cfg.n_epochs,
+        batch_size=cfg.batch_size,
+        vf_coef=cfg.vf_coef,
+        max_grad_norm=cfg.max_grad_norm,
+        normalize_advantage=cfg.normalize_advantage,
+        log_std_init=cfg.log_std_init,
+    )
+
+
+def train_config_from_config(cfg) -> TrainConfig:
+    run_name = str(cfg.name)  # hydra parses numeric-looking names as ints
+    return TrainConfig(
+        num_formations=cfg.num_formation,
+        total_timesteps=cfg.total_timesteps,
+        seed=cfg.seed,
+        save_freq=cfg.save_freq,
+        name=run_name,
+        log_dir=str(repo_root() / "logs" / run_name),
+        use_wandb=cfg.use_wandb,
+        resume=cfg.get("resume", False),
+        log_interval=cfg.log_interval,
+    )
+
+
+def shard_fn_from_config(cfg):
+    if not cfg.get("mesh"):
+        return None
+    from marl_distributedformation_tpu.parallel import make_shard_fn
+
+    return make_shard_fn(dict(cfg.mesh))
+
+
 def build_trainer(cfg) -> Trainer:
     if cfg.backend != "jax":
         raise SystemExit(
@@ -32,8 +72,11 @@ def build_trainer(cfg) -> Trainer:
             "lives in the original repository)."
         )
     env_params = env_params_from_config(cfg)
+    ppo = ppo_from_config(cfg)
+    train_cfg = train_config_from_config(cfg)
+    shard_fn = shard_fn_from_config(cfg)
     if cfg.get("curriculum"):
-        return build_hetero_trainer(cfg, env_params)
+        return build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn)
     policy = cfg.get("policy", "mlp")
     model = None
     if policy == "ctde":
@@ -61,43 +104,12 @@ def build_trainer(cfg) -> Trainer:
             f"policy={cfg.policy!r} is not implemented; available: "
             "mlp, ctde, gnn"
         )
-    ppo = PPOConfig(
-        n_steps=cfg.n_steps,
-        learning_rate=cfg.learning_rate,
-        ent_coef=cfg.ent_coef,
-        gamma=cfg.gamma,
-        gae_lambda=cfg.gae_lambda,
-        clip_range=cfg.clip_range,
-        n_epochs=cfg.n_epochs,
-        batch_size=cfg.batch_size,
-        vf_coef=cfg.vf_coef,
-        max_grad_norm=cfg.max_grad_norm,
-        normalize_advantage=cfg.normalize_advantage,
-        log_std_init=cfg.log_std_init,
-    )
-    run_name = str(cfg.name)  # hydra parses numeric-looking names as ints
-    train_cfg = TrainConfig(
-        num_formations=cfg.num_formation,
-        total_timesteps=cfg.total_timesteps,
-        seed=cfg.seed,
-        save_freq=cfg.save_freq,
-        name=run_name,
-        log_dir=str(repo_root() / "logs" / run_name),
-        use_wandb=cfg.use_wandb,
-        resume=cfg.get("resume", False),
-        log_interval=cfg.log_interval,
-    )
-    shard_fn = None
-    if cfg.get("mesh"):
-        from marl_distributedformation_tpu.parallel import make_shard_fn
-
-        shard_fn = make_shard_fn(dict(cfg.mesh))
     return Trainer(
         env_params, ppo=ppo, config=train_cfg, model=model, shard_fn=shard_fn
     )
 
 
-def build_hetero_trainer(cfg, env_params):
+def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn):
     """Curriculum path (BASELINE.json config 5): mixed-size padded formations
     with an obstacle field, staged over ``cfg.curriculum``."""
     from marl_distributedformation_tpu.train import (
@@ -110,38 +122,19 @@ def build_hetero_trainer(cfg, env_params):
             "curriculum training uses the shared per-agent MLP policy "
             "(padded agents are masked per transition); set policy=mlp"
         )
+    if env_params.obs_mode != "ring":
+        raise SystemExit(
+            "curriculum training uses the ring observation model (padded "
+            f"formations mask the ring per transition); obs_mode="
+            f"{env_params.obs_mode!r} is not supported — set obs_mode=ring"
+        )
     curriculum = curriculum_from_cfg(cfg.curriculum)
-    ppo = PPOConfig(
-        n_steps=cfg.n_steps,
-        learning_rate=cfg.learning_rate,
-        ent_coef=cfg.ent_coef,
-        gamma=cfg.gamma,
-        gae_lambda=cfg.gae_lambda,
-        clip_range=cfg.clip_range,
-        n_epochs=cfg.n_epochs,
-        batch_size=cfg.batch_size,
-        vf_coef=cfg.vf_coef,
-        max_grad_norm=cfg.max_grad_norm,
-        normalize_advantage=cfg.normalize_advantage,
-        log_std_init=cfg.log_std_init,
-    )
-    run_name = str(cfg.name)
-    train_cfg = TrainConfig(
-        num_formations=cfg.num_formation,
-        total_timesteps=cfg.total_timesteps,
-        seed=cfg.seed,
-        save_freq=cfg.save_freq,
-        name=run_name,
-        log_dir=str(repo_root() / "logs" / run_name),
-        use_wandb=cfg.use_wandb,
-        resume=cfg.get("resume", False),
-        log_interval=cfg.log_interval,
-    )
     return HeteroTrainer(
         curriculum=curriculum,
         env_params=env_params,
         ppo=ppo,
         config=train_cfg,
+        shard_fn=shard_fn,
     )
 
 
