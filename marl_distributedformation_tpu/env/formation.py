@@ -22,6 +22,7 @@ step compiles to one fused XLA program.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Dict, Tuple
 
 import jax
@@ -149,10 +150,6 @@ def compute_obs(
             "knn obs is incompatible with the ring halo-exchange path; "
             "shard formations ('dp') only for knn swarms"
         )
-        if agents.ndim > 2:
-            return jax.vmap(compute_obs, in_axes=(0, 0, None))(
-                agents, goal, params
-            )
         return compute_obs_knn(agents, goal, params)
     wh = jnp.array([params.width, params.height], dtype=jnp.float32)
     if pos_neighbors is None:
@@ -177,21 +174,31 @@ def compute_obs_knn(agents: Array, goal: Array, params: EnvParams) -> Array:
     neighbor indices (k)]``. Indices are exact int values carried in float32
     (N < 2^24) so formation-level graph models (models/gnn.py) can gather
     neighbor embeddings for message passing; MLP policies simply learn to
-    ignore them. Single formation ``(N, 2)``; callers ``vmap`` over M.
+    ignore them.
+
+    Shape-generic: single formation ``agents (N, 2)``/``goal (2,)`` runs the
+    per-formation XLA search (vmap-safe); batched ``(M, N, 2)``/``(M, 2)``
+    dispatches through ``ops.knn_batch`` so the fused Pallas kernel
+    (ops/knn_pallas.py, selected by ``EnvParams.knn_impl``) sees the whole
+    batch at once and the ``(M, N, N)`` distance tensor never touches HBM.
     """
-    from marl_distributedformation_tpu.ops import knn
+    from marl_distributedformation_tpu.ops import knn, knn_batch
 
     wh = jnp.array([params.width, params.height], dtype=jnp.float32)
     diag = float(np.hypot(params.width, params.height))
-    idx, offsets, dists = knn(agents, params.knn_k)
-    n = agents.shape[0]
+    if agents.ndim > 2:
+        idx, offsets, dists = knn_batch(
+            agents, params.knn_k, impl=params.knn_impl
+        )
+    else:
+        idx, offsets, dists = knn(agents, params.knn_k)
     parts = [
         agents / wh,
-        (offsets / wh).reshape(n, 2 * params.knn_k),
+        (offsets / wh).reshape(*agents.shape[:-1], 2 * params.knn_k),
         dists / diag,
     ]
     if params.goal_in_obs:
-        parts.append((goal[None, :] - agents) / wh)
+        parts.append((goal[..., None, :] - agents) / wh)
     parts.append(idx.astype(jnp.float32))
     return jnp.concatenate(parts, axis=-1)
 
@@ -312,7 +319,10 @@ def compute_metrics(
 
 
 def step(
-    state: FormationState, velocity: Array, params: EnvParams
+    state: FormationState,
+    velocity: Array,
+    params: EnvParams,
+    with_obs: bool = True,
 ) -> Tuple[FormationState, Transition]:
     """Advance one formation by one step.
 
@@ -353,7 +363,14 @@ def step(
     fresh = reset(state.key, params)
     next_state = tree_select(done, fresh, stepped)
 
-    obs = compute_obs(next_state.agents, next_state.goal, params)
+    if with_obs:
+        obs = compute_obs(next_state.agents, next_state.goal, params)
+    else:
+        # Placeholder for callers that compute obs once over the whole batch
+        # after the vmap (step_batch's knn path); XLA dead-code-eliminates it.
+        obs = jnp.zeros(
+            (state.agents.shape[-2], params.obs_dim), jnp.float32
+        )
     metrics = compute_metrics(next_state.agents, next_state.goal, params)
     metrics.update({k: v.mean() for k, v in reward_terms.items()})
     metrics["reward"] = reward.mean()
@@ -381,7 +398,18 @@ def step_batch(
     state: FormationState, velocity: Array, params: EnvParams
 ) -> Tuple[FormationState, Transition]:
     """Step a batch of formations: state leaves and ``velocity`` carry a
-    leading formation axis M; ``velocity`` is ``(M, N, 2)``."""
+    leading formation axis M; ``velocity`` is ``(M, N, 2)``.
+
+    For ``obs_mode="knn"`` the per-formation step runs without obs and the
+    neighbor-graph observation is computed once over the whole batch, so the
+    fused Pallas search (ops/knn_pallas.py) sees ``(M, N, 2)`` directly.
+    """
+    if params.obs_mode == "knn":
+        next_state, tr = jax.vmap(
+            functools.partial(step, with_obs=False), in_axes=(0, 0, None)
+        )(state, velocity, params)
+        obs = compute_obs(next_state.agents, next_state.goal, params)
+        return next_state, tr.replace(obs=obs)
     return jax.vmap(step, in_axes=(0, 0, None))(state, velocity, params)
 
 
@@ -402,9 +430,7 @@ def make_vec_env(
     @jax.jit
     def reset_fn(key: Array) -> Tuple[FormationState, Array]:
         state = reset_batch(key, params, num_formations)
-        obs = jax.vmap(compute_obs, in_axes=(0, 0, None))(
-            state.agents, state.goal, params
-        )
+        obs = compute_obs(state.agents, state.goal, params)
         return state, obs
 
     @jax.jit

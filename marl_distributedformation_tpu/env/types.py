@@ -77,6 +77,12 @@ class EnvParams:
     knn_k: int = 4
     """Neighbor count for ``obs_mode="knn"``; must be < num_agents."""
 
+    knn_impl: str = "auto"
+    """Neighbor-search implementation for batched knn observations:
+    ``"auto"`` (fused Pallas kernel on TPU, XLA elsewhere), ``"xla"``,
+    ``"pallas"``, or ``"pallas_interpret"`` (CPU-debuggable kernel).
+    See ops/knn.py ``knn_batch``."""
+
     obstacle_mode: str = "parity"
     """``"parity"``: the reference's inconsistent geometry (Q2) — the obstacle
     point is treated as the lower-left corner of an ``obstacle_size``-sided box
@@ -96,6 +102,12 @@ class EnvParams:
             assert 1 <= self.knn_k < self.num_agents, (
                 f"knn_k={self.knn_k} must be in [1, num_agents)"
             )
+        assert self.knn_impl in (
+            "auto",
+            "xla",
+            "pallas",
+            "pallas_interpret",
+        ), f"unknown knn_impl {self.knn_impl!r}"
 
     @property
     def desired_neighbor_dist(self) -> float:

@@ -1,6 +1,7 @@
-"""TPU compute ops: k-NN neighbor search."""
+"""TPU compute ops: k-NN neighbor search (XLA and fused Pallas paths)."""
 
 from marl_distributedformation_tpu.ops.knn import (  # noqa: F401
     knn,
+    knn_batch,
     pairwise_sq_dists,
 )

@@ -75,3 +75,33 @@ def knn(
     if valid is not None:
         dists = jnp.where(real, dists, 0.0)
     return idx, offsets, dists
+
+
+def knn_batch(
+    points: Array,
+    k: int,
+    valid: Array = None,
+    impl: str = "auto",
+) -> Tuple[Array, Array, Array]:
+    """Batched k-NN over ``points (M, N, 2)`` with implementation dispatch.
+
+    ``impl``: ``"xla"`` — ``vmap`` of :func:`knn` (works everywhere);
+    ``"pallas"`` — the fused TPU kernel (ops/knn_pallas.py), which never
+    materializes the ``(M, N, N)`` distance tensor in HBM;
+    ``"pallas_interpret"`` — the same kernel in interpret mode (CPU tests);
+    ``"auto"`` — pallas on TPU backends, xla elsewhere.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl in ("pallas", "pallas_interpret"):
+        from marl_distributedformation_tpu.ops.knn_pallas import (
+            knn_batch_pallas,
+        )
+
+        return knn_batch_pallas(
+            points, k, valid, interpret=(impl == "pallas_interpret")
+        )
+    assert impl == "xla", f"unknown knn impl {impl!r}"
+    if valid is None:
+        return jax.vmap(lambda p: knn(p, k))(points)
+    return jax.vmap(lambda p, v: knn(p, k, v))(points, valid)

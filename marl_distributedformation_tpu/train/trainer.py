@@ -106,7 +106,9 @@ class Trainer:
         self.env_state = reset_batch(
             k_env, env_params, config.num_formations
         )
-        self.obs = jax.vmap(compute_obs, in_axes=(0, 0, None))(
+        # compute_obs is shape-generic over the leading formation axis and
+        # routes knn obs through the batched (Pallas-capable) search.
+        self.obs = compute_obs(
             self.env_state.agents, self.env_state.goal, env_params
         )
 
