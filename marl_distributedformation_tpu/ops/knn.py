@@ -89,10 +89,17 @@ def knn_batch(
     ``"pallas"`` — the fused TPU kernel (ops/knn_pallas.py), which never
     materializes the ``(M, N, N)`` distance tensor in HBM;
     ``"pallas_interpret"`` — the same kernel in interpret mode (CPU tests);
-    ``"auto"`` — pallas on TPU backends, xla elsewhere.
+    ``"auto"`` — pallas on TPU backends when the kernel's intermediates fit
+    VMEM (N up to ~700), xla elsewhere.
     """
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        from marl_distributedformation_tpu.ops.knn_pallas import fits_vmem
+
+        impl = (
+            "pallas"
+            if jax.default_backend() == "tpu" and fits_vmem(points.shape[1])
+            else "xla"
+        )
     if impl in ("pallas", "pallas_interpret"):
         from marl_distributedformation_tpu.ops.knn_pallas import (
             knn_batch_pallas,

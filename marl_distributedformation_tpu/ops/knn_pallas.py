@@ -42,6 +42,18 @@ from marl_distributedformation_tpu.ops.knn import _SELF_MASK
 Array = jax.Array
 
 _LANE = 128
+_VMEM_BUDGET = 12 * 1024 * 1024  # bytes; ~6 live (block_m, Np, Np) f32 bufs
+
+
+def padded_n(n: int) -> int:
+    return max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE)
+
+
+def fits_vmem(n: int) -> bool:
+    """True when the kernel's intermediates fit the VMEM budget even at the
+    minimum block_m=1 — the dispatch condition for ``impl="auto"``."""
+    np_ = padded_n(n)
+    return 6 * 4 * np_ * np_ <= _VMEM_BUDGET
 
 
 def _knn_kernel(k, x_ref, y_ref, vmask_ref, idx_ref, offx_ref, offy_ref,
@@ -110,12 +122,18 @@ def knn_batch_pallas(
     m, n, d = points.shape
     assert d == 2, f"knn_batch_pallas is 2-D only, got d={d}"
     assert k < n, f"knn needs k < N (k={k}, N={n})"
-    n_pad = max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE)
+    n_pad = padded_n(n)
+    if not fits_vmem(n):
+        raise ValueError(
+            f"knn_batch_pallas: N={n} (padded {n_pad}) needs "
+            f"~{6 * 4 * n_pad * n_pad >> 20} MB of VMEM intermediates even "
+            f"at block_m=1 (budget {_VMEM_BUDGET >> 20} MB); use the XLA "
+            "path (knn_batch(..., impl='xla') / EnvParams.knn_impl='xla')"
+        )
     if block_m is None:
         # ~6 live (block_m, Np, Np) f32 intermediates (d2, xb, yb, masks)
-        # under a ~12 MB VMEM budget.
-        budget = 12 * 1024 * 1024 // (6 * 4)
-        block_m = max(1, min(8, budget // (n_pad * n_pad)))
+        # under the VMEM budget.
+        block_m = max(1, min(8, _VMEM_BUDGET // (6 * 4) // (n_pad * n_pad)))
     m_pad = ((m + block_m - 1) // block_m) * block_m
 
     pts = points.astype(jnp.float32)

@@ -1,5 +1,14 @@
-"""Device-mesh parallelism: dp over formations, ring exchange over agents."""
+"""Device-mesh parallelism: dp over formations, ring exchange over agents,
+multi-host wire-up and hybrid DCN x ICI meshes."""
 
+from marl_distributedformation_tpu.parallel.distributed import (  # noqa: F401
+    global_from_local,
+    init_distributed,
+    is_coordinator,
+    local_formation_slice,
+    make_hybrid_mesh,
+    reset_batch_sharded,
+)
 from marl_distributedformation_tpu.parallel.mesh import (  # noqa: F401
     formation_sharding,
     make_mesh,

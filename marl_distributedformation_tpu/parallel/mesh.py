@@ -23,13 +23,15 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def make_mesh(axis_sizes: Dict[str, int]) -> Mesh:
-    """Build a mesh with named axes, e.g. ``{"dp": 4}`` or
-    ``{"dp": 4, "sp": 2}``. Total size must divide the device count; use
-    size -1 for one axis to mean "all remaining devices"."""
+def resolve_axis_sizes(
+    axis_sizes: Dict[str, int], n_devices: int
+) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+    """Resolve a ``{name: size}`` spec against the device count: a single
+    -1 means "all remaining devices"; the total may not exceed
+    ``n_devices``. Shared by :func:`make_mesh` and
+    ``distributed.make_hybrid_mesh``."""
     names = tuple(axis_sizes.keys())
     sizes = list(axis_sizes.values())
-    n_devices = len(jax.devices())
     if -1 in sizes:
         known = int(np.prod([s for s in sizes if s != -1]))
         sizes[sizes.index(-1)] = n_devices // known
@@ -39,6 +41,15 @@ def make_mesh(axis_sizes: Dict[str, int]) -> Mesh:
             f"mesh {dict(zip(names, sizes))} needs {total} devices; "
             f"only {n_devices} available"
         )
+    return names, tuple(sizes)
+
+
+def make_mesh(axis_sizes: Dict[str, int]) -> Mesh:
+    """Build a mesh with named axes, e.g. ``{"dp": 4}`` or
+    ``{"dp": 4, "sp": 2}``. Total size must divide the device count; use
+    size -1 for one axis to mean "all remaining devices"."""
+    names, sizes = resolve_axis_sizes(axis_sizes, len(jax.devices()))
+    total = int(np.prod(sizes))
     devices = mesh_utils.create_device_mesh(
         tuple(sizes), devices=jax.devices()[:total]
     )

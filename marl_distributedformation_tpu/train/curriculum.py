@@ -391,18 +391,36 @@ class HeteroTrainer:
         return str(path)
 
     def _try_resume(self) -> None:
-        path = latest_checkpoint(self.log_dir)
-        if path is None:
-            return
-        restored = restore_checkpoint(path, self._checkpoint_target())
+        if jax.process_count() > 1:
+            # Coordinator-only disk: broadcast the learner state so every
+            # host agrees on params/counters (utils.broadcast_restore). The
+            # "policy" name string can't ride the broadcast and is excluded.
+            from marl_distributedformation_tpu.utils import broadcast_restore
+
+            template = {
+                k: v
+                for k, v in self._checkpoint_target().items()
+                if k != "policy"
+            }
+            restored = broadcast_restore(self.log_dir, template)
+            if restored is None:
+                return
+            restored["key"] = jnp.asarray(restored["key"])
+        else:
+            path = latest_checkpoint(self.log_dir)
+            if path is None:
+                return
+            restored = restore_checkpoint(path, self._checkpoint_target())
         self.train_state = self.train_state.replace(
             params=restored["params"], opt_state=restored["opt_state"]
         )
         self.key = restored["key"]
         self.num_timesteps = int(restored["num_timesteps"])
         self.completed_rollouts = int(restored["completed_rollouts"])
+        # Mesh re-placement (multi-host replication included) happens in
+        # start_stage via shard_fn before any iteration runs.
         print(
-            f"[hetero] resumed from {path} at {self.num_timesteps} steps "
+            f"[hetero] resumed at {self.num_timesteps} steps "
             f"({self.completed_rollouts} rollouts)"
         )
 

@@ -14,6 +14,7 @@ is ``5000 * num_formations`` (vectorized_env.py:116,134).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -35,7 +36,7 @@ from marl_distributedformation_tpu.utils import (
     Throughput,
     latest_checkpoint,
     repo_root,
-    restore_checkpoint,
+    restore_checkpoint_partial,
     save_checkpoint,
 )
 
@@ -103,20 +104,41 @@ class Trainer:
             tx=ppo.make_optimizer(),
         )
 
-        self.env_state = reset_batch(
-            k_env, env_params, config.num_formations
-        )
-        # compute_obs is shape-generic over the leading formation axis and
-        # routes knn obs through the batched (Pallas-capable) search.
-        self.obs = compute_obs(
-            self.env_state.agents, self.env_state.goal, env_params
-        )
-
         self._shard_fn = shard_fn
-        if shard_fn is not None:
-            self.train_state, self.env_state, self.obs = shard_fn(
-                self.train_state, self.env_state, self.obs
+        self._multihost = jax.process_count() > 1
+        if self._multihost:
+            # Multi-host: every process builds only its own formation shard
+            # (parallel/distributed.py) — device_put onto a global mesh from
+            # full host arrays is not possible across processes.
+            assert shard_fn is not None and getattr(
+                shard_fn, "mesh", None
+            ), "multi-host training needs a mesh (cfg.mesh / make_shard_fn)"
+            from marl_distributedformation_tpu.parallel import (
+                replicate,
+                reset_batch_sharded,
             )
+
+            mesh = shard_fn.mesh
+            self.env_state = reset_batch_sharded(
+                k_env, env_params, config.num_formations, mesh
+            )
+            self.obs = jax.jit(
+                functools.partial(compute_obs, params=env_params)
+            )(self.env_state.agents, self.env_state.goal)
+            self.train_state = replicate(self.train_state, mesh)
+        else:
+            self.env_state = reset_batch(
+                k_env, env_params, config.num_formations
+            )
+            # compute_obs is shape-generic over the leading formation axis
+            # and routes knn obs through the batched (Pallas-capable) search.
+            self.obs = compute_obs(
+                self.env_state.agents, self.env_state.goal, env_params
+            )
+            if shard_fn is not None:
+                self.train_state, self.env_state, self.obs = shard_fn(
+                    self.train_state, self.env_state, self.obs
+                )
 
         self.num_timesteps = 0
         self._vec_steps_since_save = 0
@@ -259,15 +281,21 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _checkpoint_target(self) -> Dict[str, Any]:
-        return {
+        target = {
             "policy": self.model.__class__.__name__,
             "params": self.train_state.params,
             "opt_state": self.train_state.opt_state,
             "key": self.key,
             "num_timesteps": self.num_timesteps,
-            "env_state": self.env_state,
-            "obs": self.obs,
         }
+        if not self._multihost:
+            # dp-sharded env state is not coordinator-addressable across
+            # hosts; multi-host checkpoints carry the learner state only and
+            # resume re-resets the environment (on-policy PPO loses nothing
+            # but the tail of one rollout).
+            target["env_state"] = self.env_state
+            target["obs"] = self.obs
+        return target
 
     def save(self) -> str:
         path = save_checkpoint(
@@ -276,22 +304,58 @@ class Trainer:
         self._vec_steps_since_save = 0
         return str(path)
 
+    def _learner_template(self) -> Dict[str, Any]:
+        return {
+            "params": self.train_state.params,
+            "opt_state": self.train_state.opt_state,
+            "key": self.key,
+            "num_timesteps": self.num_timesteps,
+        }
+
     def _try_resume(self) -> None:
+        if self._multihost:
+            self._try_resume_multihost()
+            return
         path = latest_checkpoint(self.log_dir)
         if path is None:
             return
-        restored = restore_checkpoint(path, self._checkpoint_target())
+        # Partial restore: a multi-host-written (learner-only) checkpoint
+        # resumes fine single-host — env state just starts fresh.
+        restored = restore_checkpoint_partial(
+            path, self._checkpoint_target()
+        )
         self.train_state = self.train_state.replace(
             params=restored["params"], opt_state=restored["opt_state"]
         )
         self.key = restored["key"]
         self.num_timesteps = int(restored["num_timesteps"])
-        self.env_state = restored["env_state"]
-        self.obs = restored["obs"]
+        if "env_state" in restored:
+            self.env_state = restored["env_state"]
+            self.obs = restored["obs"]
         if self._shard_fn is not None:
-            # Checkpoints restore as host arrays; re-place them on the mesh
-            # or the resumed run silently trains single-device.
+            # Checkpoints restore as host arrays; re-place them on the
+            # mesh or the resumed run silently trains single-device.
             self.train_state, self.env_state, self.obs = self._shard_fn(
                 self.train_state, self.env_state, self.obs
             )
         print(f"[trainer] resumed from {path} at {self.num_timesteps} steps")
+
+    def _try_resume_multihost(self) -> None:
+        """Coordinator restores, every host receives the same learner state
+        (utils.broadcast_restore); env state stays freshly reset."""
+        from marl_distributedformation_tpu.parallel import replicate
+        from marl_distributedformation_tpu.utils import broadcast_restore
+
+        restored = broadcast_restore(self.log_dir, self._learner_template())
+        if restored is None:
+            return
+        self.train_state = self.train_state.replace(
+            params=restored["params"], opt_state=restored["opt_state"]
+        )
+        self.key = jnp.asarray(restored["key"])
+        self.num_timesteps = int(restored["num_timesteps"])
+        self.train_state = replicate(self.train_state, self._shard_fn.mesh)
+        print(
+            f"[trainer] process {jax.process_index()} resumed (broadcast) "
+            f"at {self.num_timesteps} steps"
+        )

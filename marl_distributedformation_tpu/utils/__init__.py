@@ -9,10 +9,12 @@ from marl_distributedformation_tpu.utils.config import (  # noqa: F401
     setup_platform,
 )
 from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
+    broadcast_restore,
     checkpoint_path,
     checkpoint_step,
     latest_checkpoint,
     restore_checkpoint,
+    restore_checkpoint_partial,
     save_checkpoint,
 )
 from marl_distributedformation_tpu.utils.logging import MetricsLogger  # noqa: F401

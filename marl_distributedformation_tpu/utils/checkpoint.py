@@ -30,8 +30,21 @@ def checkpoint_path(log_dir: str | Path, num_timesteps: int) -> Path:
 def save_checkpoint(
     log_dir: str | Path, num_timesteps: int, target: Any
 ) -> Path:
-    """Serialize ``target`` (any pytree) to ``rl_model_{steps}_steps.msgpack``."""
+    """Serialize ``target`` (any pytree) to ``rl_model_{steps}_steps.msgpack``.
+
+    Multi-host: only the coordinator process writes (every host returns the
+    would-be path). Leaves must be process-addressable on the coordinator —
+    replicated trees (params/opt state) always are; cross-host-sharded state
+    must be excluded by the caller (as ``Trainer._checkpoint_target`` does
+    for the dp-sharded env state).
+    """
+    from marl_distributedformation_tpu.parallel.distributed import (
+        is_coordinator,
+    )
+
     path = checkpoint_path(log_dir, num_timesteps)
+    if not is_coordinator():
+        return path
     path.parent.mkdir(parents=True, exist_ok=True)
     # Dot-prefixed temp name so a torn write can never be picked up by
     # latest_checkpoint (which also filters on the .msgpack suffix).
@@ -61,6 +74,73 @@ def restore_checkpoint(path: str | Path, template: Any) -> Any:
     """Restore a pytree serialized by ``save_checkpoint`` into the structure
     of ``template`` (same-treedef pytree with correctly-shaped leaves)."""
     return serialization.from_bytes(template, Path(path).read_bytes())
+
+
+def restore_checkpoint_partial(
+    path: str | Path, template: dict
+) -> dict:
+    """Restore the intersection of a dict checkpoint and a dict template.
+
+    Checkpoints written in different launch modes carry different keys
+    (multi-host learner-only checkpoints omit the cross-host-sharded env
+    state); this restores every template key present in the file and simply
+    omits the rest, so a single-host checkpoint resumes multi-host and vice
+    versa. Extra keys in the file are ignored.
+    """
+    raw = serialization.msgpack_restore(Path(path).read_bytes())
+    assert isinstance(raw, dict), f"checkpoint at {path} is not a dict"
+    return {
+        k: serialization.from_state_dict(tmpl, raw[k])
+        for k, tmpl in template.items()
+        if k in raw
+    }
+
+
+def broadcast_restore(log_dir: str | Path, template: dict) -> Optional[dict]:
+    """Multi-host resume: the coordinator reads its latest checkpoint and
+    every host receives the identical restored state.
+
+    Checkpoints exist on the coordinator's disk only, so both the
+    found/not-found decision and the state are broadcast — otherwise hosts
+    would disagree on params/counters and the SPMD loop would deadlock on
+    mismatched collective counts. ``template`` must be array/scalar leaves
+    only (no strings — they can't ride the broadcast). Returns None when no
+    checkpoint exists; all template keys must be present in the file.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from marl_distributedformation_tpu.parallel.distributed import (
+        is_coordinator,
+    )
+
+    # ALL fallible coordinator work happens before the first broadcast:
+    # if the coordinator raised mid-protocol, the other hosts would block
+    # forever inside broadcast_one_to_all (a silent cluster hang). On
+    # failure the coordinator broadcasts found=0 first — peers proceed with
+    # a fresh start — and then re-raises so the launcher tears the job down
+    # with a real error.
+    restored, found, err = template, 0, None
+    if is_coordinator():
+        try:
+            path = latest_checkpoint(log_dir)
+            if path is not None:
+                restored = restore_checkpoint_partial(path, template)
+                missing = set(template) - set(restored)
+                if missing:
+                    raise ValueError(
+                        f"checkpoint {path} is missing learner state "
+                        f"{missing}"
+                    )
+                found = 1
+        except Exception as e:  # noqa: BLE001 — converted to fail-fast
+            restored, found, err = template, 0, e
+    found = int(multihost_utils.broadcast_one_to_all(np.int32(found)))
+    if err is not None:
+        raise err
+    if not found:
+        return None
+    return multihost_utils.broadcast_one_to_all(restored)
 
 
 def checkpoint_step(path: str | Path) -> int:

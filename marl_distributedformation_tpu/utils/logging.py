@@ -30,15 +30,25 @@ class MetricsLogger:
         wandb_project: str = "formation-rl",
         stdout_every: int = 10,
     ) -> None:
+        from marl_distributedformation_tpu.parallel.distributed import (
+            is_coordinator,
+        )
+
+        # Multi-host: metrics in the jitted step are already globally
+        # reduced, so only the coordinator emits; other hosts no-op.
+        self._active = is_coordinator()
         self.log_dir = Path(log_dir)
-        self.log_dir.mkdir(parents=True, exist_ok=True)
         self.jsonl_path = self.log_dir / "metrics.jsonl"
-        self._file = open(self.jsonl_path, "a", buffering=1)
+        self._file = None
+        if self._active:
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+            self._file = open(self.jsonl_path, "a", buffering=1)
         self.stdout_every = stdout_every
         self._emit_count = 0
         self._start = time.time()
 
         self._wandb = None
+        use_wandb = use_wandb and self._active
         if use_wandb:
             try:
                 import wandb
@@ -54,6 +64,8 @@ class MetricsLogger:
 
     def log(self, metrics: Dict[str, Any], step: int) -> None:
         """Emit one metrics record at ``step`` (agent-transitions)."""
+        if not self._active:
+            return
         record = {"step": int(step), "time": time.time() - self._start}
         for k, v in metrics.items():
             record[k] = float(v)
@@ -70,6 +82,7 @@ class MetricsLogger:
             print(f"[metrics] step={record['step']} {brief}", file=sys.stderr)
 
     def close(self) -> None:
-        self._file.close()
+        if self._file is not None:
+            self._file.close()
         if self._wandb is not None:
             self._wandb.finish()

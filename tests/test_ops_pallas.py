@@ -76,6 +76,21 @@ def test_ascending_distance_order():
     assert (np.diff(d, axis=-1) >= -1e-6).all()
 
 
+def test_vmem_guard_rejects_oversized_n():
+    from marl_distributedformation_tpu.ops.knn_pallas import fits_vmem
+
+    assert fits_vmem(512) and not fits_vmem(1000)
+    pts = jnp.zeros((1, 1000, 2))
+    with pytest.raises(ValueError, match="VMEM"):
+        knn_batch_pallas(pts, 4, interpret=True)
+    # auto dispatch must quietly take the XLA path instead of exploding
+    idx, _, _ = knn_batch(
+        jax.random.uniform(jax.random.PRNGKey(0), (1, 1000, 2)), 4,
+        impl="auto",
+    )
+    assert idx.shape == (1, 1000, 4)
+
+
 def test_knn_batch_dispatch():
     pts = jax.random.uniform(jax.random.PRNGKey(11), (2, 30, 2)) * 50.0
     _assert_matches(

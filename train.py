@@ -59,9 +59,14 @@ def train_config_from_config(cfg) -> TrainConfig:
 def shard_fn_from_config(cfg):
     if not cfg.get("mesh"):
         return None
-    from marl_distributedformation_tpu.parallel import make_shard_fn
+    from marl_distributedformation_tpu.parallel import (
+        make_hybrid_mesh,
+        make_shard_fn,
+    )
 
-    return make_shard_fn(dict(cfg.mesh))
+    # Hybrid construction keeps the gradient psum on ICI within a slice
+    # with only slice-partials over DCN; single-slice it is a plain mesh.
+    return make_shard_fn(mesh=make_hybrid_mesh(dict(cfg.mesh)))
 
 
 def build_trainer(cfg) -> Trainer:
@@ -141,6 +146,16 @@ def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn):
 def main(argv=None) -> None:
     cfg = load_config(sys.argv[1:] if argv is None else argv)
     setup_platform(cfg.get("platform"))
+    from marl_distributedformation_tpu.parallel import init_distributed
+
+    if init_distributed():  # no-op single-process; env-var driven multi-host
+        import jax
+
+        print(
+            f"[train] multi-host: process {jax.process_index()}/"
+            f"{jax.process_count()}, {len(jax.local_devices())} local "
+            f"of {len(jax.devices())} global devices"
+        )
     trainer = build_trainer(cfg)
     print(
         f"[train] {cfg.name}: M={cfg.num_formation} formations x "
