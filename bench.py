@@ -1,99 +1,314 @@
 #!/usr/bin/env python
-"""Headline benchmark: parallel-formation env throughput on one chip.
+"""Headline benchmark: formation-env + PPO-train throughput on one chip.
 
-Measures env-steps/sec (formation steps per second) for M=4096 parallel
-5-agent formations driven by a uniform random policy inside one jitted
-``lax.scan`` — the BASELINE.json north-star configuration ("4096 parallel
-5-agent formations ... on 1 TPU core"). The reference achieves 1,066
-formation-steps/s at its default M=1000x5 on CPU (BASELINE.md, measured:
-sequential Python loop over torch simulators, vectorized_env.py:71-81);
-``vs_baseline`` is the speedup over that number.
+Measures, inside one process and one JSON line:
 
-Prints exactly one JSON line:
+- ``env_steps_per_sec`` (headline ``value``): M parallel N-agent formations
+  driven by a uniform random policy inside one jitted ``lax.scan`` — the
+  BASELINE.json north-star configuration (M=4096 x N=5 on one TPU core).
+  The reference achieves 1,066 formation-steps/s at its default M=1000x5 on
+  CPU (BASELINE.md, measured: sequential Python loop over torch simulators,
+  reference vectorized_env.py:71-81); ``vs_baseline`` is the speedup over
+  that number.
+- ``train_env_steps_per_sec``: the FULL PPO training iteration
+  (rollout + GAE + minibatch-epoch update — the ``Trainer._iteration`` XLA
+  program), in formation-steps/s. This is the workload the framework exists
+  for, not just env stepping.
+- ``knn_env_steps_per_sec``: the large-swarm variant (N=100 agents, k-NN
+  observation graph, BASELINE.json config 4).
+
+Hardened against the flaky axon tunnel (round-1 failure mode: the first
+device op hung for minutes and the round recorded nothing):
+
+- the backend is probed in a SUBPROCESS with a hard timeout, retried once
+  with backoff; if the probe never answers, the bench falls back to the CPU
+  backend (recorded via ``"platform"``/``"fallback"`` fields) so a parseable
+  number is always emitted;
+- every phase checks a global deadline (``BENCH_BUDGET_S``, default 600s)
+  and per-phase failures degrade to a note instead of killing the run;
+- any unexpected error still prints the one JSON line, with an ``"error"``
+  field.
+
+Env-var knobs: BENCH_M, BENCH_N, BENCH_CHUNK, BENCH_TRAIN_M, BENCH_KNN_M,
+BENCH_BUDGET_S, BENCH_PROBE_TIMEOUT_S, BENCH_FORCE_CPU=1,
+BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1.
+
+Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
-
-import jax
-import jax.numpy as jnp
-
-from marl_distributedformation_tpu.env import EnvParams
-from marl_distributedformation_tpu.env.formation import reset_batch, step_batch
 
 REFERENCE_FORMATION_STEPS_PER_SEC = 1066.0  # BASELINE.md, M=1000 x N=5, CPU
 
-M = 4096  # parallel formations (north-star config)
-N = 5  # agents per formation (default cfg)
-CHUNK = 4096  # env steps per scan (amortizes tunnel RTT; see BENCH notes)
-REPEATS = 4  # timed scans
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
 
 
-def make_runner(params: EnvParams):
+M = _env_int("BENCH_M", 4096)  # parallel formations (north-star config)
+N = _env_int("BENCH_N", 5)  # agents per formation (default cfg)
+CHUNK = _env_int("BENCH_CHUNK", 1024)  # env steps per jitted scan
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 600))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 75))
+MIN_TIMED_S = 3.0  # keep timing until a phase has at least this much signal
+
+
+def probe_backend(
+    timeout_s: float = PROBE_TIMEOUT_S, attempts: int = 2, backoff_s: float = 10.0
+):
+    """Ask a subprocess what backend JAX resolves to, under a hard timeout.
+
+    Round 1 showed ``jax.devices()`` can hang for minutes when the tunneled
+    TPU is unreachable; probing out-of-process keeps this process healthy and
+    lets it fall back to CPU. Returns the platform string or None.
+    """
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            for line in reversed(out.stdout.splitlines()):
+                if line.startswith("PLATFORM="):
+                    return line.split("=", 1)[1].strip()
+        except subprocess.TimeoutExpired:
+            print(
+                f"[bench] backend probe attempt {i + 1} timed out "
+                f"after {timeout_s:.0f}s",
+                file=sys.stderr,
+            )
+        if i + 1 < attempts:
+            time.sleep(backoff_s)
+    return None
+
+
+def make_runner(params, m: int, chunk: int):
+    """Jitted random-policy env-stepping chunk: ``chunk`` vec-steps of ``m``
+    formations per call (amortizes dispatch/tunnel RTT)."""
+    import jax
+
+    from marl_distributedformation_tpu.env.formation import step_batch
+
     @jax.jit
     def run_chunk(state, key):
         def body(carry, _):
             state, key = carry
             key, k_act = jax.random.split(key)
             # Uniform random policy in [-1, 1], scaled like the adapter
-            # (vectorized_env.py:69-70) — matches how BASELINE.md measured
-            # the reference (env stepping only, no policy inference).
+            # (reference vectorized_env.py:69-70) — matches how BASELINE.md
+            # measured the reference (env stepping only, no policy inference).
             actions = jax.random.uniform(
-                k_act, (M, params.num_agents, 2), minval=-1.0, maxval=1.0
+                k_act, (m, params.num_agents, 2), minval=-1.0, maxval=1.0
             )
-            state, tr = step_batch(
-                state, params.max_speed * actions, params
-            )
+            state, tr = step_batch(state, params.max_speed * actions, params)
             return (state, key), tr.reward.mean()
+
         (state, key), rewards = jax.lax.scan(
-            body, (state, key), None, length=CHUNK
+            body, (state, key), None, length=chunk
         )
         return state, key, rewards.mean()
 
     return run_chunk
 
 
-def main() -> None:
-    params = EnvParams(num_agents=N)
-    key = jax.random.PRNGKey(0)
-    state = reset_batch(key, params, M)
-    run_chunk = make_runner(params)
+def _time_env_phase(params, m: int, chunk: int, deadline: float) -> float:
+    """Adaptive timing: warm up (compile + 1 exec), then run timed chunks
+    until MIN_TIMED_S of signal or the deadline. Returns formation-steps/s."""
+    import jax
 
-    # Warmup: compile + one execution.
+    from marl_distributedformation_tpu.env.formation import reset_batch
+
+    state = reset_batch(jax.random.PRNGKey(0), params, m)
+    run_chunk = make_runner(params, m, chunk)
+
     state, key, r = run_chunk(state, jax.random.PRNGKey(1))
-    float(r)
+    float(r)  # hard host sync: block_until_ready under-reports on axon
 
+    repeats = 0
     t0 = time.perf_counter()
-    for _ in range(REPEATS):
+    while True:
         state, key, r = run_chunk(state, key)
-    float(r)  # hard host sync — block_until_ready under-reports on the
-    # experimental axon platform (returns before queued chunks finish)
-    elapsed = time.perf_counter() - t0
+        float(r)
+        repeats += 1
+        elapsed = time.perf_counter() - t0
+        if elapsed >= MIN_TIMED_S or time.time() > deadline or repeats >= 64:
+            break
+    return m * chunk * repeats / elapsed
 
-    env_steps = M * CHUNK * REPEATS
-    rate = env_steps / elapsed
-    print(
-        f"[bench] device={jax.devices()[0].device_kind} M={M} N={N} "
-        f"steps={env_steps} elapsed={elapsed:.3f}s "
-        f"agent_steps_per_sec={rate * N:.0f}",
-        file=sys.stderr,
+
+def _time_train_phase(n_agents: int, m: int, deadline: float):
+    """Time the full jitted PPO iteration (rollout + GAE + update) —
+    ``Trainer._iteration``. Returns (train_env_steps_per_sec, iters_per_sec,
+    n_steps)."""
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+
+    ppo = PPOConfig()
+    trainer = Trainer(
+        EnvParams(num_agents=n_agents),
+        ppo=ppo,
+        config=TrainConfig(
+            num_formations=m, checkpoint=False, use_wandb=False, name="bench"
+        ),
     )
-    print(
-        json.dumps(
-            {
-                "metric": f"env_steps_per_sec_{M}x{N}_single_chip",
-                "value": round(rate, 1),
-                "unit": "env-steps/s",
-                "vs_baseline": round(
-                    rate / REFERENCE_FORMATION_STEPS_PER_SEC, 2
-                ),
-            }
+    metrics = trainer.run_iteration()  # warmup: compile + 1 exec
+    float(metrics["loss"])
+
+    iters = 0
+    t0 = time.perf_counter()
+    while True:
+        metrics = trainer.run_iteration()
+        float(metrics["loss"])  # host sync
+        iters += 1
+        elapsed = time.perf_counter() - t0
+        if elapsed >= MIN_TIMED_S or time.time() > deadline or iters >= 256:
+            break
+    rate = ppo.n_steps * m * iters / elapsed
+    return rate, iters / elapsed, ppo.n_steps
+
+
+def _make_emitter(result: dict):
+    """Single-shot JSON emitter shared by the main path and the watchdog, so
+    exactly one JSON line prints no matter which one gets there."""
+    emitted = threading.Event()
+    lock = threading.Lock()
+
+    def emit():
+        with lock:
+            if not emitted.is_set():
+                print(json.dumps(result), flush=True)
+                emitted.set()
+
+    return emit
+
+
+def main() -> None:
+    deadline = time.time() + BUDGET_S
+    result = {
+        "metric": f"env_steps_per_sec_{M}x{N}_single_chip",
+        "value": 0.0,
+        "unit": "env-steps/s",
+        "vs_baseline": 0.0,
+    }
+    notes = []
+    emit = _make_emitter(result)
+
+    def watchdog():
+        # Device ops in THIS process have no timeout (the probe only covers
+        # a subprocess): if the tunnel drops between probe and use, a compile
+        # or execute can hang forever — a hang is not an Exception, so the
+        # try/except below never fires. Guarantee the JSON line anyway, then
+        # hard-exit (daemon threads can't interrupt a stuck runtime call).
+        time.sleep(max(deadline - time.time(), 0.0) + 60.0)
+        result.setdefault(
+            "error", "watchdog: budget exceeded (device op hang?)"
         )
-    )
+        if notes:
+            result.setdefault("notes", "; ".join(notes))
+        emit()
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+        platform = None if force_cpu else probe_backend()
+        fallback = platform is None and not force_cpu
+
+        import jax
+
+        if platform is None:
+            jax.config.update("jax_platforms", "cpu")
+            if fallback:
+                notes.append("device backend unreachable; fell back to CPU")
+        # Report what the process ACTUALLY runs on (config.update cannot
+        # switch an already-initialized backend, e.g. under pytest).
+        platform = jax.default_backend()
+        on_accel = platform != "cpu"  # tunneled TPU reports "axon", not "tpu"
+        result["platform"] = platform
+        result["fallback"] = fallback
+        result["device"] = jax.devices()[0].device_kind
+
+        from marl_distributedformation_tpu.env import EnvParams
+
+        # Phase 1 — headline: random-policy env stepping, north-star shape.
+        rate = _time_env_phase(
+            EnvParams(num_agents=N), M, CHUNK, deadline
+        )
+        result["value"] = round(rate, 1)
+        result["vs_baseline"] = round(
+            rate / REFERENCE_FORMATION_STEPS_PER_SEC, 2
+        )
+        result["agent_steps_per_sec"] = round(rate * N, 1)
+        print(
+            f"[bench] env: {rate:,.0f} formation-steps/s on {platform}",
+            file=sys.stderr,
+        )
+
+        # Phase 2 — full PPO training iteration.
+        if os.environ.get("BENCH_SKIP_TRAIN") != "1":
+            if time.time() < deadline - 30:
+                try:
+                    train_m = _env_int(
+                        "BENCH_TRAIN_M", M if on_accel else 256
+                    )
+                    t_rate, t_iters, n_steps = _time_train_phase(
+                        N, train_m, deadline
+                    )
+                    result["train_env_steps_per_sec"] = round(t_rate, 1)
+                    result["train_iters_per_sec"] = round(t_iters, 2)
+                    result["train_m"] = train_m
+                    result["train_n_steps"] = n_steps
+                    print(
+                        f"[bench] train: {t_rate:,.0f} formation-steps/s "
+                        f"({t_iters:.2f} iters/s at M={train_m})",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade, don't die
+                    notes.append(f"train phase failed: {e!r}"[:200])
+            else:
+                notes.append("train phase skipped: deadline")
+
+        # Phase 3 — large-swarm knn variant (BASELINE.json config 4).
+        if os.environ.get("BENCH_SKIP_KNN") != "1":
+            if time.time() < deadline - 30:
+                try:
+                    knn_m = _env_int(
+                        "BENCH_KNN_M", 4096 if on_accel else 256
+                    )
+                    knn_params = EnvParams(
+                        num_agents=100, obs_mode="knn", knn_k=4
+                    )
+                    k_rate = _time_env_phase(
+                        knn_params, knn_m, max(CHUNK // 8, 16), deadline
+                    )
+                    result["knn_env_steps_per_sec"] = round(k_rate, 1)
+                    result["knn_m"] = knn_m
+                    print(
+                        f"[bench] knn (N=100): {k_rate:,.0f} "
+                        "formation-steps/s",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    notes.append(f"knn phase failed: {e!r}"[:200])
+            else:
+                notes.append("knn phase skipped: deadline")
+    except Exception as e:  # noqa: BLE001 — the JSON line must still print
+        result["error"] = repr(e)[:300]
+    if notes:
+        result["notes"] = "; ".join(notes)
+    emit()
 
 
 if __name__ == "__main__":

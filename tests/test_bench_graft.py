@@ -13,20 +13,38 @@ import bench as bench_mod
 import __graft_entry__ as graft
 
 
-def test_bench_runner_compiles_and_steps(monkeypatch):
-    monkeypatch.setattr(bench_mod, "M", 8)
-    monkeypatch.setattr(bench_mod, "CHUNK", 4)
+def test_bench_runner_compiles_and_steps():
     from marl_distributedformation_tpu.env import EnvParams
     from marl_distributedformation_tpu.env.formation import reset_batch
 
     params = EnvParams(num_agents=bench_mod.N)
     state = reset_batch(jax.random.PRNGKey(0), params, 8)
-    run_chunk = bench_mod.make_runner(params)
+    run_chunk = bench_mod.make_runner(params, m=8, chunk=4)
     state2, key, r = run_chunk(state, jax.random.PRNGKey(1))
     assert np.isfinite(float(r))
     assert not np.allclose(
         np.asarray(state2.agents), np.asarray(state.agents)
     )
+
+
+def test_bench_emits_parseable_json_on_cpu(monkeypatch, capsys):
+    """The one-JSON-line contract must survive any backend state: force the
+    CPU fallback path with tiny shapes and parse the output."""
+    import json
+
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    monkeypatch.setattr(bench_mod, "M", 8)
+    monkeypatch.setattr(bench_mod, "CHUNK", 4)
+    monkeypatch.setattr(bench_mod, "MIN_TIMED_S", 0.05)
+    monkeypatch.setenv("BENCH_TRAIN_M", "4")
+    monkeypatch.setenv("BENCH_KNN_M", "4")
+    bench_mod.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys()
+    assert rec["value"] > 0
+    assert rec["train_env_steps_per_sec"] > 0
+    assert rec["knn_env_steps_per_sec"] > 0
 
 
 def test_graft_entry_compiles():
