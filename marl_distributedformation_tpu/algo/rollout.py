@@ -47,6 +47,7 @@ def collect_rollout(
     env_params: EnvParams,
     n_steps: int,
     env_step_fn: Optional[Callable] = None,
+    mask: Optional[Array] = None,
 ) -> Tuple[FormationState, Array, RolloutBatch, Array]:
     """Roll ``n_steps`` vectorized env steps under the current policy.
 
@@ -59,15 +60,25 @@ def collect_rollout(
     vmapped single-chip step; pass a ring step (``parallel.make_ring_step``)
     to roll with the agent axis sharded over 'sp'.
 
+    ``mask`` is an optional ``(M, N)`` agent-validity mask forwarded to
+    per-formation models (CTDE/GNN) for padded heterogeneous batches; it is
+    constant across the rollout because ``n_agents`` is preserved through
+    auto-resets (env/hetero.py).
+
     Returns ``(env_state, last_obs, batch, last_value)``.
     """
     if env_step_fn is None:
         def env_step_fn(state, velocity):
             return step_batch(state, velocity, env_params)
 
+    def policy(obs):
+        if mask is not None:
+            return apply_fn(nn_params, obs, mask)
+        return apply_fn(nn_params, obs)
+
     def body(carry, step_key):
         env_state, obs = carry
-        mean, log_std, value = apply_fn(nn_params, obs)
+        mean, log_std, value = policy(obs)
         action = distributions.sample(step_key, mean, log_std)
         log_p = distributions.log_prob(action, mean, log_std)
         clipped = jnp.clip(action, -1.0, 1.0)
@@ -92,5 +103,5 @@ def collect_rollout(
     (env_state, last_obs), batch = jax.lax.scan(
         body, (env_state, obs), step_keys
     )
-    _, _, last_value = apply_fn(nn_params, last_obs)
+    _, _, last_value = policy(last_obs)
     return env_state, last_obs, batch, last_value

@@ -87,14 +87,13 @@ def make_shard_fn(
     (replicated params + sharded batch => psum over 'dp' on ICI).
     """
     the_mesh = mesh or make_mesh(axis_sizes or {"dp": len(jax.devices())})
-    extra_axes = set(the_mesh.shape) - {"dp"}
+    extra_axes = set(the_mesh.shape) - {"dp", "sp"}
     if extra_axes:
-        raise NotImplementedError(
-            f"shard_fn currently places only the 'dp' (formation) axis; "
-            f"mesh has {sorted(extra_axes)}. Agent-axis ('sp') sharding is "
-            "provided by parallel/ring.py and is wired into the trainer "
-            "with the large-swarm configs."
+        raise ValueError(
+            f"shard_fn places the 'dp' (formation) and 'sp' (agent) axes; "
+            f"mesh has unknown axes {sorted(extra_axes)}"
         )
+    has_sp = "sp" in the_mesh.shape
 
     def shard_fn(train_state, env_state, obs):
         dp = the_mesh.shape["dp"]
@@ -102,6 +101,21 @@ def make_shard_fn(
         if m % dp != 0:
             raise ValueError(
                 f"num_formations={m} not divisible by dp={dp}"
+            )
+        if has_sp:
+            # Agent-axis sharding: agents/obs P('dp','sp'), per-formation
+            # leaves P('dp') — the layout parallel/ring.py's halo-exchange
+            # step consumes. Trainer pairs this with make_ring_step.
+            from marl_distributedformation_tpu.parallel.ring import (
+                place_ring_state,
+            )
+
+            return (
+                replicate(train_state, the_mesh),
+                place_ring_state(env_state, the_mesh),
+                jax.device_put(
+                    obs, NamedSharding(the_mesh, P("dp", "sp"))
+                ),
             )
         return (
             replicate(train_state, the_mesh),

@@ -105,6 +105,15 @@ class Trainer:
         )
 
         self._shard_fn = shard_fn
+        # Agent-axis ('sp') sharding: swap the vmapped env step for the
+        # halo-exchange ring step (parallel/ring.py) so large swarms roll
+        # with N split across devices — constant per-device ICI traffic.
+        self._env_step_fn = None
+        mesh = getattr(shard_fn, "mesh", None)
+        if mesh is not None and "sp" in mesh.shape:
+            from marl_distributedformation_tpu.parallel import make_ring_step
+
+            self._env_step_fn = make_ring_step(env_params, mesh)
         self._multihost = jax.process_count() > 1
         if self._multihost:
             # Multi-host: every process builds only its own formation shard
@@ -157,6 +166,7 @@ class Trainer:
 
     def _make_iteration(self):
         env_params, ppo = self.env_params, self.ppo
+        env_step_fn = self._env_step_fn
         if self.per_formation:
             # Minibatch whole formations: rows are (N, ...) blocks so the
             # centralized critic sees every agent. batch_size stays denominated
@@ -185,6 +195,7 @@ class Trainer:
                 k_roll,
                 env_params,
                 ppo.n_steps,
+                env_step_fn=env_step_fn,
             )
             advantages, returns = compute_gae(
                 batch.rewards,

@@ -154,3 +154,82 @@ def test_ring_step_rejects_indivisible_agents():
     mesh = make_mesh({"dp": 2, "sp": 4})
     with pytest.raises(ValueError, match="not divisible"):
         make_ring_step(EnvParams(num_agents=6), mesh)
+
+
+# ---------------------------------------------------------------------------
+# 'sp' sharding wired end-to-end through the Trainer (VERDICT.md round-1 #2)
+# ---------------------------------------------------------------------------
+
+
+def _sp_trainer(tmp_path, shard_fn=None):
+    return Trainer(
+        EnvParams(num_agents=8),
+        ppo=PPOConfig(n_steps=4, batch_size=32, n_epochs=2),
+        config=TrainConfig(
+            num_formations=4,
+            seed=0,
+            checkpoint=False,
+            name="sp",
+            log_dir=str(tmp_path / "logs"),
+        ),
+        shard_fn=shard_fn,
+    )
+
+
+def test_sp_sharded_training_matches_single_device(tmp_path):
+    """Full train iterations on a {dp:2, sp:2} mesh: the halo-exchange env
+    step + sharded PPO update must reproduce the unsharded trajectory (env
+    states equal, params equal to fp32 reduction tolerance)."""
+    t_single = _sp_trainer(tmp_path / "single")
+    t_sp = _sp_trainer(
+        tmp_path / "sp", shard_fn=make_shard_fn({"dp": 2, "sp": 2})
+    )
+    assert t_sp._env_step_fn is not None, "sp mesh must select the ring step"
+
+    for i in range(2):
+        m_single = t_single.run_iteration()
+        m_sp = t_sp.run_iteration()
+        np.testing.assert_allclose(
+            float(m_single["reward"]), float(m_sp["reward"]),
+            rtol=1e-4, err_msg=f"iter {i}",
+        )
+        np.testing.assert_allclose(
+            float(m_single["loss"]), float(m_sp["loss"]), rtol=1e-3
+        )
+        # Same env trajectory step for step (resets included).
+        np.testing.assert_allclose(
+            np.asarray(t_single.env_state.agents),
+            np.asarray(t_sp.env_state.agents),
+            rtol=1e-4, atol=1e-3,
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(t_single.train_state.params),
+        jax.tree_util.tree_leaves(t_sp.train_state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+
+
+def test_sp_shard_fn_layout(tmp_path):
+    trainer = _sp_trainer(
+        tmp_path, shard_fn=make_shard_fn({"dp": 2, "sp": 2})
+    )
+    spec = trainer.env_state.agents.sharding.spec
+    assert tuple(spec)[:2] == ("dp", "sp")
+    trainer.run_iteration()
+    assert not trainer.env_state.agents.sharding.is_fully_replicated
+    spec_after = trainer.env_state.agents.sharding.spec
+    assert tuple(spec_after)[:2] == ("dp", "sp")
+
+
+def test_sp_shard_fn_rejects_knn_obs(tmp_path):
+    with pytest.raises(ValueError, match="sp"):
+        Trainer(
+            EnvParams(num_agents=8, obs_mode="knn", knn_k=2),
+            config=TrainConfig(
+                num_formations=4, checkpoint=False,
+                log_dir=str(tmp_path / "logs"),
+            ),
+            shard_fn=make_shard_fn({"dp": 2, "sp": 2}),
+        )
