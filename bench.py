@@ -191,6 +191,7 @@ def _make_emitter(result: dict):
                 print(json.dumps(result), flush=True)
                 emitted.set()
 
+    emit.done = emitted
     return emit
 
 
@@ -212,6 +213,8 @@ def main() -> None:
         # try/except below never fires. Guarantee the JSON line anyway, then
         # hard-exit (daemon threads can't interrupt a stuck runtime call).
         time.sleep(max(deadline - time.time(), 0.0) + 60.0)
+        if emit.done.is_set():
+            return  # bench finished normally; never kill a host process
         result.setdefault(
             "error", "watchdog: budget exceeded (device op hang?)"
         )

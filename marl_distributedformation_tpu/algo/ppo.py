@@ -64,6 +64,11 @@ class MinibatchData:
     weights: Array = None  # (b,) optional per-transition loss weights —
     #   heterogeneous (padded) formations put weight 0 on padded agents
     #   (env/hetero.py); None means uniform weights (homogeneous path).
+    mask: Array = None  # (b, N) optional agent-validity mask forwarded to
+    #   per-formation models (CTDE/GNN) so padded agents are excluded from
+    #   the pooled critic; None for agent-factored models or homogeneous
+    #   batches. Distinct from ``weights``: the mask shapes the MODEL's
+    #   forward pass, weights shape the LOSS reduction.
 
 
 def _wmean(x: Array, weights: Array) -> Array:
@@ -81,7 +86,10 @@ def ppo_loss(
     config: PPOConfig,
 ) -> Tuple[Array, Dict[str, Array]]:
     """Clipped-surrogate PPO loss on one minibatch (SB3 semantics)."""
-    mean, log_std, values = apply_fn(nn_params, mb.obs)
+    if mb.mask is not None:
+        mean, log_std, values = apply_fn(nn_params, mb.obs, mb.mask)
+    else:
+        mean, log_std, values = apply_fn(nn_params, mb.obs)
     log_probs = distributions.log_prob(mb.actions, mean, log_std)
     ent = distributions.entropy(log_std)
 

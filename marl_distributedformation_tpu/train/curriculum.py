@@ -134,9 +134,12 @@ class HeteroTrainer:
     - timestep accounting counts *active* agent-transitions (the SB3
       ``num_timesteps`` analogue, SURVEY.md §2.2, scaled to the live mix).
 
-    The policy must be agent-factored (the shared per-agent MLP — the
-    reference's parameter-sharing trick, ``vectorized_env.py:32``); padded
-    agents see zero observations and their transitions never reach the loss.
+    ``model`` may be agent-factored (the shared per-agent MLP — the
+    reference's parameter-sharing trick, ``vectorized_env.py:32``) or
+    per-formation (``CTDEActorCritic``): per-formation models receive the
+    ``(M, N_max)`` agent-validity mask in every forward pass — rollout and
+    update — so padded agents are excluded from the pooled critic, their
+    values are 0, and their transitions carry zero loss weight.
     """
 
     def __init__(
@@ -145,6 +148,7 @@ class HeteroTrainer:
         env_params: Optional[EnvParams] = None,
         ppo: PPOConfig = PPOConfig(),
         config: TrainConfig = TrainConfig(),
+        model: Any = None,
         shard_fn: Any = None,
     ) -> None:
         self.curriculum = curriculum
@@ -159,14 +163,20 @@ class HeteroTrainer:
         self.ppo = ppo
         self.config = config
 
-        self.model = MLPActorCritic(
+        self.model = model or MLPActorCritic(
             act_dim=self.env_params.act_dim, log_std_init=ppo.log_std_init
         )
+        self.per_formation = getattr(self.model, "per_formation", False)
         key = jax.random.PRNGKey(config.seed)
         self.key, k_init = jax.random.split(key)
-        params = self.model.init(
-            k_init, jnp.zeros((1, self.env_params.obs_dim), jnp.float32)
-        )
+        if self.per_formation:
+            dummy_obs = jnp.zeros(
+                (1, self.env_params.num_agents, self.env_params.obs_dim),
+                jnp.float32,
+            )
+        else:
+            dummy_obs = jnp.zeros((1, self.env_params.obs_dim), jnp.float32)
+        params = self.model.init(k_init, dummy_obs)
         self.train_state = TrainState.create(
             apply_fn=self.model.apply,
             params=params,
@@ -174,6 +184,15 @@ class HeteroTrainer:
         )
 
         self._shard_fn = shard_fn
+        mesh = getattr(shard_fn, "mesh", None)
+        if mesh is not None and "sp" in mesh.shape:
+            raise ValueError(
+                "curriculum/hetero training does not support agent-axis "
+                "('sp') sharding: padded dynamic rings gather (i±1) mod n "
+                "neighbors across the whole formation, which the ring "
+                "halo-exchange layout cannot serve — use a dp-only mesh "
+                "(mesh={dp: N})"
+            )
         self.env_state: Optional[HeteroState] = None
         self.obs: Optional[Array] = None
         self.num_timesteps = 0
@@ -196,6 +215,18 @@ class HeteroTrainer:
     def _make_iteration(self):
         env_params, ppo = self.env_params, self.ppo
         n_max = env_params.num_agents
+        per_formation = self.per_formation
+        if per_formation:
+            # Minibatch whole formations so the centralized critic sees every
+            # agent; batch_size stays denominated in agent-transitions for
+            # comparable SGD noise across policies (same as train.Trainer).
+            update_ppo = dataclasses.replace(
+                ppo, batch_size=max(1, ppo.batch_size // n_max)
+            )
+            row_shape = (n_max,)
+        else:
+            update_ppo = ppo
+            row_shape = ()
 
         def env_step(state: HeteroState, velocity: Array):
             return hetero_step_batch(state, velocity, env_params)
@@ -207,6 +238,11 @@ class HeteroTrainer:
             key: Array,
         ):
             key, k_roll, k_update = jax.random.split(key, 3)
+            # n_agents is preserved across auto-resets, so one (M, N_max)
+            # mask covers every step of the rollout (and the whole stage).
+            mask = jax.vmap(agent_mask, in_axes=(0, None))(
+                env_state.n_agents, n_max
+            ).astype(jnp.float32)
             env_state, last_obs, batch, last_value = collect_rollout(
                 train_state.apply_fn,
                 train_state.params,
@@ -216,6 +252,7 @@ class HeteroTrainer:
                 env_params,
                 ppo.n_steps,
                 env_step_fn=env_step,
+                mask=mask if per_formation else None,
             )
             advantages, returns = compute_gae(
                 batch.rewards,
@@ -225,29 +262,28 @@ class HeteroTrainer:
                 ppo.gamma,
                 ppo.gae_lambda,
             )
-            # n_agents is preserved across auto-resets, so one (M, N_max)
-            # mask covers every step of the rollout.
-            mask = jax.vmap(agent_mask, in_axes=(0, None))(
-                env_state.n_agents, n_max
-            ).astype(jnp.float32)
             weights = jnp.broadcast_to(
                 mask[None], (ppo.n_steps, *mask.shape)
-            ).reshape(-1)
+            ).reshape(-1, *row_shape)
             flat = MinibatchData(
-                obs=batch.obs.reshape(-1, env_params.obs_dim),
-                actions=batch.actions.reshape(-1, env_params.act_dim),
-                old_log_probs=batch.log_probs.reshape(-1),
-                advantages=advantages.reshape(-1),
-                returns=returns.reshape(-1),
+                obs=batch.obs.reshape(-1, *row_shape, env_params.obs_dim),
+                actions=batch.actions.reshape(
+                    -1, *row_shape, env_params.act_dim
+                ),
+                old_log_probs=batch.log_probs.reshape(-1, *row_shape),
+                advantages=advantages.reshape(-1, *row_shape),
+                returns=returns.reshape(-1, *row_shape),
                 weights=weights,
+                mask=weights if per_formation else None,
             )
             train_state, update_metrics = ppo_update(
-                train_state, flat, k_update, ppo
+                train_state, flat, k_update, update_ppo
             )
             metrics = {k: v.mean() for k, v in batch.metrics.items()}
             metrics.update(update_metrics)
-            w = jnp.maximum(weights.sum(), 1.0)
-            metrics["reward"] = (batch.rewards.reshape(-1) * weights).sum() / w
+            w_flat = weights.reshape(-1)
+            w = jnp.maximum(w_flat.sum(), 1.0)
+            metrics["reward"] = (batch.rewards.reshape(-1) * w_flat).sum() / w
             # Formation-level episode count: batch.dones is the per-formation
             # done broadcast to all N_max agent rows (rollout.py), so a plain
             # sum counts every padded row, inflating the count x N_max.

@@ -122,10 +122,13 @@ def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn):
         curriculum_from_cfg,
     )
 
-    if cfg.get("policy", "mlp") != "mlp":
+    policy = cfg.get("policy", "mlp")
+    if policy not in ("mlp", "ctde"):
         raise SystemExit(
-            "curriculum training uses the shared per-agent MLP policy "
-            "(padded agents are masked per transition); set policy=mlp"
+            f"curriculum training supports policy=mlp (shared per-agent "
+            f"MLP) and policy=ctde (masked centralized critic); "
+            f"policy={policy!r} is not supported — the GNN needs knn obs, "
+            "and heterogeneous formations are ring-observed"
         )
     if env_params.obs_mode != "ring":
         raise SystemExit(
@@ -133,12 +136,20 @@ def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn):
             f"formations mask the ring per transition); obs_mode="
             f"{env_params.obs_mode!r} is not supported — set obs_mode=ring"
         )
+    model = None
+    if policy == "ctde":
+        from marl_distributedformation_tpu.models import CTDEActorCritic
+
+        model = CTDEActorCritic(
+            act_dim=env_params.act_dim, log_std_init=cfg.log_std_init
+        )
     curriculum = curriculum_from_cfg(cfg.curriculum)
     return HeteroTrainer(
         curriculum=curriculum,
         env_params=env_params,
         ppo=ppo,
         config=train_cfg,
+        model=model,
         shard_fn=shard_fn,
     )
 
