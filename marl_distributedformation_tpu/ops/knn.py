@@ -77,6 +77,46 @@ def knn(
     return idx, offsets, dists
 
 
+def _resolve_auto_impl(points: Array) -> str:
+    """The ``impl="auto"`` dispatch predicate, factored out so tests can
+    pin the backend: pallas only on TPU, only when the intermediates fit
+    VMEM, and only when the SPMD partitioner does NOT control the batch."""
+    from marl_distributedformation_tpu.ops.knn_pallas import fits_vmem
+
+    return (
+        "pallas"
+        if (
+            jax.default_backend() == "tpu"
+            and fits_vmem(points.shape[1])
+            and not _spmd_partitioner_controlled(points)
+        )
+        else "xla"
+    )
+
+
+def _spmd_partitioner_controlled(points: Array) -> bool:
+    """True when ``points`` lives on (or is traced under) a multi-device
+    mesh whose axes the XLA SPMD partitioner controls.
+
+    Three cases, via sharding-in-types avals (jax >= 0.9):
+    - concrete array committed to >1 device: the implicit jit around the
+      kernel would need the partitioner -> True;
+    - tracer whose aval mesh is non-empty with any Auto/Explicit axis
+      (plain ``jit`` under a mesh): the partitioner will place this op ->
+      True;
+    - tracer under ``shard_map`` (all axes Manual) or no mesh at all: the
+      kernel sees a per-device local block -> False.
+    """
+    if not isinstance(points, jax.core.Tracer):
+        sharding = getattr(points, "sharding", None)
+        return sharding is not None and len(sharding.device_set) > 1
+    aval = getattr(points, "aval", None)
+    mesh = getattr(getattr(aval, "sharding", None), "mesh", None)
+    if mesh is None or not mesh.axis_types:
+        return False
+    return any(t != jax.sharding.AxisType.Manual for t in mesh.axis_types)
+
+
 def knn_batch(
     points: Array,
     k: int,
@@ -90,16 +130,15 @@ def knn_batch(
     materializes the ``(M, N, N)`` distance tensor in HBM;
     ``"pallas_interpret"`` — the same kernel in interpret mode (CPU tests);
     ``"auto"`` — pallas on TPU backends when the kernel's intermediates fit
-    VMEM (N up to ~700), xla elsewhere.
+    VMEM (N up to ~700) AND the batch is not under SPMD-partitioner control
+    (a ``pallas_call`` is a Mosaic custom call the partitioner cannot split,
+    so a dp-sharded batch traced under plain ``jit`` falls back to xla;
+    inside ``shard_map`` — where the kernel sees its local block — pallas is
+    selected again; ``parallel.make_dp_step`` provides that wrapping for
+    sharded training).
     """
     if impl == "auto":
-        from marl_distributedformation_tpu.ops.knn_pallas import fits_vmem
-
-        impl = (
-            "pallas"
-            if jax.default_backend() == "tpu" and fits_vmem(points.shape[1])
-            else "xla"
-        )
+        impl = _resolve_auto_impl(points)
     if impl in ("pallas", "pallas_interpret"):
         from marl_distributedformation_tpu.ops.knn_pallas import (
             knn_batch_pallas,

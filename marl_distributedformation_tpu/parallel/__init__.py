@@ -11,6 +11,7 @@ from marl_distributedformation_tpu.parallel.distributed import (  # noqa: F401
 )
 from marl_distributedformation_tpu.parallel.mesh import (  # noqa: F401
     formation_sharding,
+    make_dp_step,
     make_mesh,
     make_shard_fn,
     replicate,

@@ -15,6 +15,7 @@ Works identically on real TPU meshes and on CPU test meshes created with
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -73,6 +74,38 @@ def shard_batch(tree: Any, mesh: Mesh) -> Any:
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
     return jax.device_put(tree, replicated(mesh))
+
+
+def make_dp_step(params: Any, mesh: Mesh) -> Callable:
+    """Batched env step explicitly shard_mapped over 'dp': each device steps
+    only its local formation block (the step has no cross-formation
+    communication, so no collectives are needed).
+
+    Required for knn observations on a mesh: the fused neighbor kernel
+    (ops/knn_pallas.py) is a Mosaic custom call the XLA SPMD partitioner
+    cannot split, so under plain ``jit`` the ``impl="auto"`` dispatch falls
+    back to the XLA search (ops/knn.py ``_spmd_partitioner_controlled``).
+    Inside this shard_map the kernel sees a per-device local ``(m_local, N,
+    2)`` block — Manual mesh axes — and "auto" selects Pallas again.
+    """
+    from marl_distributedformation_tpu.env.formation import step_batch
+
+    spec = P("dp")
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=(spec, spec),
+        # pallas_call outputs carry no varying-across-mesh metadata, which
+        # trips the vma checker; the step is collective-free so the check
+        # buys nothing here.
+        check_vma=False,
+    )
+    def dp_step(state, velocity):
+        return step_batch(state, velocity, params)
+
+    return dp_step
 
 
 def make_shard_fn(

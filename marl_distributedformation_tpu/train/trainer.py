@@ -114,6 +114,13 @@ class Trainer:
             from marl_distributedformation_tpu.parallel import make_ring_step
 
             self._env_step_fn = make_ring_step(env_params, mesh)
+        elif mesh is not None and env_params.obs_mode == "knn":
+            # knn on a dp mesh: shard_map the env step so the Pallas
+            # neighbor kernel sees its local block (the SPMD partitioner
+            # cannot split a pallas_call; see parallel.make_dp_step).
+            from marl_distributedformation_tpu.parallel import make_dp_step
+
+            self._env_step_fn = make_dp_step(env_params, mesh)
         self._multihost = jax.process_count() > 1
         if self._multihost:
             # Multi-host: every process builds only its own formation shard

@@ -19,4 +19,7 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# MDF_TPU_TESTS=1 leaves the real backend in place so the @skipif-cpu tests
+# (compiled-mode Pallas parity) can actually run on hardware.
+if os.environ.get("MDF_TPU_TESTS") != "1":
+    jax.config.update("jax_platforms", "cpu")

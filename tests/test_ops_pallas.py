@@ -136,3 +136,52 @@ def test_reset_obs_batch_path():
     np.testing.assert_allclose(
         np.asarray(batched), np.asarray(single), rtol=1e-6, atol=1e-6
     )
+
+
+@pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="compiled-mode Pallas needs a real TPU backend — run "
+    "`MDF_TPU_TESTS=1 pytest` (conftest opt-out) or "
+    "`python tests/tpu_compiled_parity.py` on hardware (VERDICT.md "
+    "round-1 #5)",
+)
+def test_compiled_pallas_parity_on_tpu():
+    """North-star shape (M=4096, N=100, k=4): the COMPILED kernel must match
+    the XLA path. Interpret mode (the CPU tests above) does not exercise
+    Mosaic lowering; this does. Single source of truth for the assertion:
+    tests/tpu_compiled_parity.py."""
+    from tpu_compiled_parity import run_parity
+
+    run_parity()
+
+
+def test_auto_dispatch_consults_spmd_guard(monkeypatch):
+    """With the backend pinned to 'tpu', the auto dispatch must pick xla for
+    partitioner-controlled batches and pallas for local ones — guarding the
+    round-1 ADVICE-high regression at the dispatch level."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import importlib
+
+    # ops/__init__ rebinds the name `knn` to the function, so attribute-style
+    # module imports resolve to it; go through the module registry instead.
+    knn_mod = importlib.import_module(
+        "marl_distributedformation_tpu.ops.knn"
+    )
+    from marl_distributedformation_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(
+        knn_mod.jax, "default_backend", lambda: "tpu"
+    )
+    pts = jnp.zeros((16, 12, 2))
+    assert knn_mod._resolve_auto_impl(pts) == "pallas"
+    mesh = make_mesh({"dp": 8})
+    pts_dp = jax.device_put(pts, NamedSharding(mesh, P("dp")))
+    assert knn_mod._resolve_auto_impl(pts_dp) == "xla"
+    seen = []
+    jax.jit(
+        lambda p: seen.append(knn_mod._resolve_auto_impl(p)) or p
+    )(pts_dp)
+    assert seen[-1] == "xla"
+    big = jnp.zeros((4, 4096, 2))  # over the VMEM budget at block_m=1
+    assert knn_mod._resolve_auto_impl(big) == "xla"

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Compiled-mode Pallas k-NN parity check (VERDICT.md round-1 #5).
+
+The pytest suite pins JAX to CPU (conftest.py), where the kernel only runs
+in interpret mode — Mosaic lowering is never exercised there. This module
+holds the single copy of the compiled-parity assertion:
+
+- on hardware, run it directly: ``python tests/tpu_compiled_parity.py``
+  (prints one PARITY_OK / PARITY_FAIL line), or run the whole suite with
+  ``MDF_TPU_TESTS=1 pytest tests/`` (conftest leaves the real backend on and
+  ``test_ops_pallas.py::test_compiled_pallas_parity_on_tpu`` calls
+  :func:`run_parity`);
+- bench.py's knn phase also exercises the compiled kernel on TPU
+  (``impl="auto"`` selects it inside the jitted scan).
+"""
+
+import sys
+
+
+def run_parity(m: int = 4096, n: int = 100, k: int = 4) -> str:
+    """Assert compiled-pallas == xla at the north-star swarm shape; returns
+    a human-readable OK message, raises AssertionError on mismatch."""
+    import jax
+    import numpy as np
+
+    from marl_distributedformation_tpu.ops import knn_batch
+    from marl_distributedformation_tpu.ops.knn_pallas import knn_batch_pallas
+
+    pts = jax.random.uniform(jax.random.PRNGKey(0), (m, n, 2)) * 400.0
+    idx_p, off_p, d_p = jax.block_until_ready(knn_batch_pallas(pts, k))
+    idx_x, off_x, d_x = knn_batch(pts, k, impl="xla")
+    np.testing.assert_array_equal(np.asarray(idx_p), np.asarray(idx_x))
+    np.testing.assert_allclose(
+        np.asarray(d_p), np.asarray(d_x), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(off_p), np.asarray(off_x), rtol=1e-4, atol=1e-4
+    )
+    return (
+        f"compiled pallas == xla on {jax.devices()[0].device_kind} "
+        f"(M={m}, N={n}, k={k})"
+    )
+
+
+def main() -> None:
+    import jax
+
+    if jax.default_backend() == "cpu":
+        print("PARITY_SKIP: no accelerator backend", flush=True)
+        return
+    try:
+        msg = run_parity()
+    except AssertionError as e:
+        print(f"PARITY_FAIL: {e}", flush=True)
+        sys.exit(1)
+    print(f"PARITY_OK: {msg}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
