@@ -78,13 +78,15 @@ def collect_rollout(
 
     def body(carry, step_key):
         env_state, obs = carry
-        mean, log_std, value = policy(obs)
-        action = distributions.sample(step_key, mean, log_std)
-        log_p = distributions.log_prob(action, mean, log_std)
+        with jax.named_scope("policy"):
+            mean, log_std, value = policy(obs)
+            action = distributions.sample(step_key, mean, log_std)
+            log_p = distributions.log_prob(action, mean, log_std)
         clipped = jnp.clip(action, -1.0, 1.0)
-        env_state, tr = env_step_fn(
-            env_state, env_params.max_speed * clipped
-        )
+        with jax.named_scope("env_step"):
+            env_state, tr = env_step_fn(
+                env_state, env_params.max_speed * clipped
+            )
         done_agents = jnp.broadcast_to(
             tr.done[:, None], tr.reward.shape
         ).astype(jnp.float32)

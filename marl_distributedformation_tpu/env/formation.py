@@ -366,11 +366,11 @@ def step(
     if with_obs:
         obs = compute_obs(next_state.agents, next_state.goal, params)
     else:
-        # Placeholder for callers that compute obs once over the whole batch
-        # after the vmap (step_batch's knn path); XLA dead-code-eliminates it.
-        obs = jnp.zeros(
-            (state.agents.shape[-2], params.obs_dim), jnp.float32
-        )
+        # Zero-width placeholder for callers that compute obs once over the
+        # whole batch after the vmap (step_batch's knn path) and then
+        # ``replace`` it — costs nothing even if a caller keeps it live
+        # (no reliance on XLA dead-code elimination).
+        obs = jnp.zeros((state.agents.shape[-2], 0), jnp.float32)
     metrics = compute_metrics(next_state.agents, next_state.goal, params)
     metrics.update({k: v.mean() for k, v in reward_terms.items()})
     metrics["reward"] = reward.mean()

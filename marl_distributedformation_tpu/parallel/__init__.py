@@ -3,6 +3,7 @@ multi-host wire-up and hybrid DCN x ICI meshes."""
 
 from marl_distributedformation_tpu.parallel.distributed import (  # noqa: F401
     global_from_local,
+    hetero_reset_batch_sharded,
     init_distributed,
     is_coordinator,
     local_formation_slice,

@@ -31,6 +31,23 @@ from marl_distributedformation_tpu.parallel.mesh import make_mesh
 _initialized = False
 
 
+# Env markers of the cluster launchers jax.distributed's auto-detection
+# understands (Cloud TPU pods/multislice, Slurm, Open MPI). When one is
+# present and no explicit coordinator config was given,
+# ``jax.distributed.initialize()`` is called with NO arguments so jax's
+# cluster detection resolves coordinator/process info — merely *not* calling
+# initialize() would silently run N independent single-host jobs (round-1
+# ADVICE finding: jax only auto-detects when initialize() is actually
+# called).
+_CLUSTER_ENV_MARKERS = (
+    "TPU_WORKER_HOSTNAMES",  # Cloud TPU pod slice
+    "TPU_WORKER_ID",
+    "MEGASCALE_COORDINATOR_ADDRESS",  # multislice
+    "SLURM_JOB_NUM_NODES",
+    "OMPI_MCA_orte_hnp_uri",
+)
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -39,11 +56,13 @@ def init_distributed(
     """Idempotent ``jax.distributed.initialize`` wrapper.
 
     Arguments default to the standard env vars (``JAX_COORDINATOR_ADDRESS``
-    / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``; TPU pod slices are also
-    auto-detected by jax itself when launched through the usual tooling).
-    Returns True if a multi-process runtime was (or already is) up, False
-    for plain single-process operation — callers never need to branch on
-    the launch mode themselves.
+    / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``). Without explicit config,
+    a recognized cluster launch environment (TPU pod, multislice, Slurm,
+    OMPI — ``_CLUSTER_ENV_MARKERS``) triggers argument-free
+    ``jax.distributed.initialize()`` so jax's own cluster detection wires
+    the processes together. Returns True if a multi-process runtime was (or
+    already is) up, False for plain single-process operation — callers never
+    need to branch on the launch mode themselves.
     """
     global _initialized
     # Resolve the launch configuration BEFORE touching anything that could
@@ -62,12 +81,22 @@ def init_distributed(
         process_id if process_id is not None
         else (int(env_pid) if env_pid else None)
     )
-    if _initialized or coordinator_address is None or num_processes in (
-        None,
-        1,
-    ):
-        # Single-process launch, repeat call, or a runtime jax already wired
-        # up itself (TPU pod auto-detection). Safe to query now.
+    if _initialized:
+        return jax.process_count() > 1
+    if coordinator_address is None or num_processes in (None, 1):
+        if num_processes != 1 and any(
+            os.environ.get(v) for v in _CLUSTER_ENV_MARKERS
+        ):
+            # Cluster launch without explicit wiring: let jax detect it.
+            try:
+                jax.distributed.initialize()
+            except Exception as e:  # noqa: BLE001 — degrade to single-proc
+                print(
+                    "[distributed] cluster env detected but "
+                    f"jax.distributed.initialize() failed ({e!r}); "
+                    "continuing single-process"
+                )
+        # else: plain single-process launch — safe to query below.
         _initialized = True
         return jax.process_count() > 1
     jax.distributed.initialize(
@@ -205,4 +234,30 @@ def reset_batch_sharded(
     start, count = local_formation_slice(num_formations)
     keys = jax.random.split(key, num_formations)[start : start + count]
     local = jax.vmap(reset, in_axes=(0, None))(keys, params)
+    return global_from_local(local, mesh)
+
+
+def hetero_reset_batch_sharded(
+    key: Any, params: Any, n_agents: Any, n_obstacles: Any, mesh: Mesh
+) -> Any:
+    """Multi-host-safe ``env.hetero.hetero_reset_batch``: the curriculum's
+    per-formation counts are computed identically on every host (same PRNG
+    key), but each host materializes only its formation slice of the padded
+    state — mirroring :func:`reset_batch_sharded` for the hetero trainer's
+    ``start_stage`` (round-1 ADVICE: building the full batch per host both
+    crashed ``device_put`` across processes and violated the per-host-shard
+    design). Single-process this equals ``hetero_reset_batch`` placed on the
+    mesh.
+    """
+    from marl_distributedformation_tpu.env.hetero import hetero_reset
+
+    num_formations = int(n_agents.shape[0])
+    start, count = local_formation_slice(num_formations)
+    keys = jax.random.split(key, num_formations)[start : start + count]
+    local = jax.vmap(hetero_reset, in_axes=(0, None, 0, 0))(
+        keys,
+        params,
+        n_agents[start : start + count],
+        n_obstacles[start : start + count],
+    )
     return global_from_local(local, mesh)

@@ -313,24 +313,51 @@ class HeteroTrainer:
         )
 
     def start_stage(self, stage: CurriculumStage) -> None:
-        """Resample the formation mix and reset every formation."""
+        """Resample the formation mix and reset every formation.
+
+        Multi-host: the stage counts derive from the replicated ``self.key``
+        so every host samples the identical mix, but each host materializes
+        only its own formation slice of the padded state
+        (``parallel.hetero_reset_batch_sharded``) — mirroring ``Trainer``'s
+        multi-host construction (no full batch on any host, no cross-process
+        ``device_put``).
+        """
         self.key, k_counts, k_env = jax.random.split(self.key, 3)
         n_agents, n_obstacles = sample_stage_counts(
             k_counts, stage, self.config.num_formations
         )
-        self.env_state = hetero_reset_batch(
-            k_env, self.env_params, n_agents, n_obstacles
-        )
-        self.obs = jax.vmap(hetero_compute_obs, in_axes=(0, None))(
-            self.env_state, self.env_params
-        )
-        if self._shard_fn is not None:
-            # Every stage builds a fresh env state on the host; re-place it
-            # (and keep params replicated) on the mesh. This also covers
-            # resume, since start_stage always precedes run_iteration.
-            self.train_state, self.env_state, self.obs = self._shard_fn(
-                self.train_state, self.env_state, self.obs
+        if jax.process_count() > 1:
+            from marl_distributedformation_tpu.parallel import (
+                hetero_reset_batch_sharded,
+                replicate,
             )
+
+            assert self._shard_fn is not None and getattr(
+                self._shard_fn, "mesh", None
+            ), "multi-host hetero training needs a mesh (cfg.mesh)"
+            mesh = self._shard_fn.mesh
+            self.env_state = hetero_reset_batch_sharded(
+                k_env, self.env_params, n_agents, n_obstacles, mesh
+            )
+            self.obs = jax.jit(
+                jax.vmap(hetero_compute_obs, in_axes=(0, None)),
+                static_argnums=1,
+            )(self.env_state, self.env_params)
+            self.train_state = replicate(self.train_state, mesh)
+        else:
+            self.env_state = hetero_reset_batch(
+                k_env, self.env_params, n_agents, n_obstacles
+            )
+            self.obs = jax.vmap(hetero_compute_obs, in_axes=(0, None))(
+                self.env_state, self.env_params
+            )
+            if self._shard_fn is not None:
+                # Every stage builds a fresh env state on the host; re-place
+                # it (and keep params replicated) on the mesh. This also
+                # covers resume, since start_stage precedes run_iteration.
+                self.train_state, self.env_state, self.obs = self._shard_fn(
+                    self.train_state, self.env_state, self.obs
+                )
         self._active_agents = int(n_agents.sum())
 
     def run_iteration(self) -> Dict[str, Array]:
@@ -419,12 +446,14 @@ class HeteroTrainer:
             "completed_rollouts": self.completed_rollouts,
         }
 
-    def save(self) -> str:
+    def save(self) -> Optional[str]:
+        """Coordinator returns the written path, other hosts None (see
+        utils.save_checkpoint's multi-host contract)."""
         path = save_checkpoint(
             self.log_dir, self.num_timesteps, self._checkpoint_target()
         )
         self._vec_steps_since_save = 0
-        return str(path)
+        return str(path) if path is not None else None
 
     def _try_resume(self) -> None:
         if jax.process_count() > 1:

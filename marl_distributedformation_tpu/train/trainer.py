@@ -58,6 +58,9 @@ class TrainConfig:
     use_wandb: bool = False
     resume: bool = False
     log_interval: int = 1  # emit metrics every k rollouts
+    profile: bool = False  # capture a jax.profiler trace of a few
+    #   post-warmup iterations into {log_dir}/profile/ (profile=true CLI)
+    profile_iterations: int = 3
 
 
 class Trainer:
@@ -158,7 +161,10 @@ class Trainer:
 
         self.num_timesteps = 0
         self._vec_steps_since_save = 0
-        self._iteration = jax.jit(self._make_iteration(), donate_argnums=(0, 1))
+        self._iteration_core = self._make_iteration()
+        self._iteration = jax.jit(
+            self._iteration_core, donate_argnums=(0, 1)
+        )
 
         self.log_dir = config.log_dir or str(
             repo_root() / "logs" / config.name
@@ -194,24 +200,26 @@ class Trainer:
             key: Array,
         ) -> Tuple[TrainState, Any, Array, Array, Dict[str, Array]]:
             key, k_roll, k_update = jax.random.split(key, 3)
-            env_state, last_obs, batch, last_value = collect_rollout(
-                train_state.apply_fn,
-                train_state.params,
-                env_state,
-                obs,
-                k_roll,
-                env_params,
-                ppo.n_steps,
-                env_step_fn=env_step_fn,
-            )
-            advantages, returns = compute_gae(
-                batch.rewards,
-                batch.values,
-                batch.dones,
-                last_value,
-                ppo.gamma,
-                ppo.gae_lambda,
-            )
+            with jax.named_scope("rollout"):
+                env_state, last_obs, batch, last_value = collect_rollout(
+                    train_state.apply_fn,
+                    train_state.params,
+                    env_state,
+                    obs,
+                    k_roll,
+                    env_params,
+                    ppo.n_steps,
+                    env_step_fn=env_step_fn,
+                )
+            with jax.named_scope("gae"):
+                advantages, returns = compute_gae(
+                    batch.rewards,
+                    batch.values,
+                    batch.dones,
+                    last_value,
+                    ppo.gamma,
+                    ppo.gae_lambda,
+                )
             flat = MinibatchData(
                 obs=batch.obs.reshape(-1, *row_shape, env_params.obs_dim),
                 actions=batch.actions.reshape(
@@ -221,9 +229,10 @@ class Trainer:
                 advantages=advantages.reshape(-1, *row_shape),
                 returns=returns.reshape(-1, *row_shape),
             )
-            train_state, update_metrics = ppo_update(
-                train_state, flat, k_update, update_ppo
-            )
+            with jax.named_scope("ppo_update"):
+                train_state, update_metrics = ppo_update(
+                    train_state, flat, k_update, update_ppo
+                )
             metrics = {
                 k: v.mean() for k, v in batch.metrics.items()
             }
@@ -271,10 +280,27 @@ class Trainer:
         meter = Throughput()
         last_record: Dict[str, float] = {}
         iteration = 0
+        # profile=true: trace a few post-warmup iterations (iteration 1 is
+        # compile-bound and would dominate the trace).
+        profiling = False
+        profile_stop = 1 + max(1, self.config.profile_iterations)
         try:
             while self.num_timesteps < self.total_timesteps:
+                if self.config.profile and iteration == 1 and not profiling:
+                    import os
+
+                    profile_dir = os.path.join(self.log_dir, "profile")
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                    print(f"[trainer] tracing -> {profile_dir}")
                 metrics = self.run_iteration()
                 iteration += 1
+                if profiling and iteration >= profile_stop:
+                    jax.tree_util.tree_map(
+                        lambda x: x.block_until_ready(), metrics
+                    )
+                    jax.profiler.stop_trace()
+                    profiling = False
                 meter.tick(self.ppo.n_steps * self.config.num_formations)
                 if iteration % self.config.log_interval == 0:
                     # One host sync per log interval, after dispatch.
@@ -291,8 +317,125 @@ class Trainer:
             if self.config.checkpoint:
                 self.save()
         finally:
+            if profiling:
+                jax.profiler.stop_trace()
             logger.close()
         return last_record
+
+    def profile_breakdown(self, iters: int = 10) -> Dict[str, float]:
+        """Where does the train-iteration time go? Times the full jitted
+        iteration and its stages as standalone programs (fractions are
+        approximate — standalone stages miss cross-stage fusion, but the
+        split is the actionable signal: env vs policy vs update).
+
+        Returns seconds per iteration: ``total``, ``rollout`` (policy
+        sampling + env stepping), ``env`` (env stepping alone with fixed
+        actions), ``update`` (GAE + minibatch epochs), and derived
+        fractions ``frac_*`` of the stage sum.
+        """
+        import time
+
+        from marl_distributedformation_tpu.env.formation import step_batch
+
+        env_params, ppo = self.env_params, self.ppo
+        ts, env_state, obs, key = (
+            self.train_state, self.env_state, self.obs, self.key,
+        )
+        env_step_fn = self._env_step_fn or (
+            lambda s, v: step_batch(s, v, env_params)
+        )
+        # Non-donating twin of self._iteration: the training jit donates its
+        # state buffers, which repeated timing calls would invalidate.
+        iteration_no_donate = jax.jit(self._iteration_core)
+
+        @jax.jit
+        def rollout_only(env_state, obs, key):
+            return collect_rollout(
+                ts.apply_fn, ts.params, env_state, obs, key, env_params,
+                ppo.n_steps, env_step_fn=self._env_step_fn,
+            )[2].rewards.sum()
+
+        @jax.jit
+        def env_only(env_state, key):
+            def body(carry, _):
+                state, key = carry
+                key, k = jax.random.split(key)
+                vel = env_params.max_speed * jax.random.uniform(
+                    k, (*state.agents.shape,), minval=-1.0, maxval=1.0
+                )
+                state, tr = env_step_fn(state, vel)
+                return (state, key), tr.reward.sum()
+
+            (_, _), r = jax.lax.scan(
+                body, (env_state, key), None, length=ppo.n_steps
+            )
+            return r.sum()
+
+        @jax.jit
+        def _collect(env_state, obs, key):
+            return collect_rollout(
+                ts.apply_fn, ts.params, env_state, obs, key, env_params,
+                ppo.n_steps, env_step_fn=self._env_step_fn,
+            )
+
+        _, last_obs, batch, last_value = _collect(env_state, obs, key)
+
+        @jax.jit
+        def update_only(key):
+            advantages, returns = compute_gae(
+                batch.rewards, batch.values, batch.dones, last_value,
+                ppo.gamma, ppo.gae_lambda,
+            )
+            n = env_params.num_agents
+            if self.per_formation:
+                row_shape = (n,)
+                update_ppo = dataclasses.replace(
+                    ppo, batch_size=max(1, ppo.batch_size // n)
+                )
+            else:
+                row_shape = ()
+                update_ppo = ppo
+            flat = MinibatchData(
+                obs=batch.obs.reshape(-1, *row_shape, env_params.obs_dim),
+                actions=batch.actions.reshape(
+                    -1, *row_shape, env_params.act_dim
+                ),
+                old_log_probs=batch.log_probs.reshape(-1, *row_shape),
+                advantages=advantages.reshape(-1, *row_shape),
+                returns=returns.reshape(-1, *row_shape),
+            )
+            _, m = ppo_update(
+                TrainState.create(
+                    apply_fn=ts.apply_fn, params=ts.params,
+                    tx=ppo.make_optimizer(),
+                ),
+                flat, key, update_ppo,
+            )
+            return m["loss"]
+
+        def timed(fn, *args):
+            jax.block_until_ready(fn(*args))  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters
+
+        result = {
+            "total": timed(
+                lambda: iteration_no_donate(ts, env_state, obs, key)[4][
+                    "loss"
+                ]
+            ),
+            "rollout": timed(rollout_only, env_state, obs, key),
+            "env": timed(env_only, env_state, key),
+            "update": timed(update_only, key),
+        }
+        result["policy"] = max(result["rollout"] - result["env"], 0.0)
+        stage_sum = result["env"] + result["policy"] + result["update"]
+        for k in ("env", "policy", "update"):
+            result[f"frac_{k}"] = result[k] / stage_sum if stage_sum else 0.0
+        return result
 
     # ------------------------------------------------------------------
     # Checkpointing (write/read contract: SURVEY.md §5)
@@ -315,12 +458,15 @@ class Trainer:
             target["obs"] = self.obs
         return target
 
-    def save(self) -> str:
+    def save(self) -> Optional[str]:
+        """Write a checkpoint; returns its path on the coordinator process
+        and None on every other host (the file exists only on the
+        coordinator's disk — see utils.save_checkpoint)."""
         path = save_checkpoint(
             self.log_dir, self.num_timesteps, self._checkpoint_target()
         )
         self._vec_steps_since_save = 0
-        return str(path)
+        return str(path) if path is not None else None
 
     def _learner_template(self) -> Dict[str, Any]:
         return {

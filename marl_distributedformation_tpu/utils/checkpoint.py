@@ -29,29 +29,39 @@ def checkpoint_path(log_dir: str | Path, num_timesteps: int) -> Path:
 
 def save_checkpoint(
     log_dir: str | Path, num_timesteps: int, target: Any
-) -> Path:
+) -> Optional[Path]:
     """Serialize ``target`` (any pytree) to ``rl_model_{steps}_steps.msgpack``.
 
-    Multi-host: only the coordinator process writes (every host returns the
-    would-be path). Leaves must be process-addressable on the coordinator —
-    replicated trees (params/opt state) always are; cross-host-sharded state
-    must be excluded by the caller (as ``Trainer._checkpoint_target`` does
-    for the dp-sharded env state).
+    Multi-host: only the coordinator process writes; it returns the path and
+    every other process returns **None** (the file does not exist on their
+    disks). A ``sync_global_devices`` barrier after the write guarantees
+    that when any process returns, the coordinator's file is durable — a
+    host may immediately hand the path to a reader. Leaves must be
+    process-addressable on the coordinator — replicated trees (params/opt
+    state) always are; cross-host-sharded state must be excluded by the
+    caller (as ``Trainer._checkpoint_target`` does for the dp-sharded env
+    state).
     """
+    import jax
+
     from marl_distributedformation_tpu.parallel.distributed import (
         is_coordinator,
     )
 
     path = checkpoint_path(log_dir, num_timesteps)
-    if not is_coordinator():
-        return path
-    path.parent.mkdir(parents=True, exist_ok=True)
-    # Dot-prefixed temp name so a torn write can never be picked up by
-    # latest_checkpoint (which also filters on the .msgpack suffix).
-    tmp = path.parent / f".{path.name}.tmp"
-    tmp.write_bytes(serialization.to_bytes(target))
-    tmp.replace(path)  # atomic: no torn checkpoints on crash (SURVEY.md §5)
-    return path
+    on_coordinator = is_coordinator()
+    if on_coordinator:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        # Dot-prefixed temp name so a torn write can never be picked up by
+        # latest_checkpoint (which also filters on the .msgpack suffix).
+        tmp = path.parent / f".{path.name}.tmp"
+        tmp.write_bytes(serialization.to_bytes(target))
+        tmp.replace(path)  # atomic: no torn checkpoints (SURVEY.md §5)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_{num_timesteps}")
+    return path if on_coordinator else None
 
 
 def latest_checkpoint(log_dir: str | Path) -> Optional[Path]:

@@ -114,3 +114,48 @@ def test_coordinator_guards_are_noops_single_process(tmp_path):
     logger.log({"reward": 1.0}, step=7)
     logger.close()
     assert (tmp_path / "metrics.jsonl").read_text().strip() != ""
+
+
+def test_hetero_reset_batch_sharded_matches_unsharded():
+    """Single-process degradation: the per-host-shard hetero reset equals
+    the plain hetero_reset_batch (same keys, same counts), globally
+    'dp'-sharded (round-1 ADVICE: HeteroTrainer multi-host start_stage)."""
+    from marl_distributedformation_tpu.env.hetero import hetero_reset_batch
+    from marl_distributedformation_tpu.parallel import (
+        hetero_reset_batch_sharded,
+        make_mesh,
+    )
+
+    params = EnvParams(num_agents=6, num_obstacles=2)
+    n_agents = jnp.asarray([3, 6, 4, 2, 6, 5, 3, 4], jnp.int32)
+    n_obstacles = jnp.asarray([0, 2, 1, 0, 2, 1, 0, 2], jnp.int32)
+    key = jax.random.PRNGKey(7)
+    mesh = make_mesh({"dp": 8})
+
+    ref = hetero_reset_batch(key, params, n_agents, n_obstacles)
+    sharded = hetero_reset_batch_sharded(
+        key, params, n_agents, n_obstacles, mesh
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref),
+        jax.tree_util.tree_leaves(sharded),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert not sharded.agents.sharding.is_fully_replicated
+
+
+def test_init_distributed_cluster_marker_fallback(monkeypatch):
+    """A cluster env marker without a reachable coordinator must degrade to
+    single-process (with a warning), not crash."""
+    import marl_distributedformation_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setenv("SLURM_JOB_NUM_NODES", "2")
+    # jax.distributed.initialize will raise (no real Slurm env) — wrapped.
+    assert dist.init_distributed() is False
+    assert dist._initialized
+
+
+def test_save_checkpoint_returns_path_single_process(tmp_path):
+    path = save_checkpoint(tmp_path, 42, {"x": jnp.zeros((2,))})
+    assert path is not None and path.exists()

@@ -169,3 +169,52 @@ def test_resume_reapplies_sharding(tmp_path):
         shard_fn=shard_fn,
     )
     assert not resumed.env_state.agents.sharding.is_fully_replicated
+
+
+def test_profile_flag_writes_trace(tmp_path):
+    """profile=True captures a jax.profiler trace of post-warmup iterations
+    into {log_dir}/profile/ (VERDICT.md round-1 #6)."""
+    import pathlib
+
+    trainer = tiny_trainer(
+        tmp_path,
+        profile=True,
+        profile_iterations=2,
+        total_timesteps=4 * 3 * 4 * 4,  # 4 iterations
+        checkpoint=False,
+    )
+    trainer.train()
+    profile_dir = pathlib.Path(trainer.log_dir) / "profile"
+    assert profile_dir.is_dir(), "no trace directory written"
+    files = list(profile_dir.rglob("*"))
+    assert any(f.is_file() for f in files), "trace directory is empty"
+
+
+def test_profile_breakdown(tmp_path):
+    trainer = tiny_trainer(tmp_path, checkpoint=False)
+    bd = trainer.profile_breakdown(iters=2)
+    for k in ("total", "rollout", "env", "update", "policy"):
+        assert bd[k] >= 0.0, bd
+    assert bd["total"] > 0.0 and bd["rollout"] > 0.0
+    np.testing.assert_allclose(
+        bd["frac_env"] + bd["frac_policy"] + bd["frac_update"], 1.0,
+        rtol=1e-6,
+    )
+    # the trainer remains usable afterwards (no donated-buffer corruption)
+    metrics = trainer.run_iteration()
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_throughput_windowed_rate():
+    import time as time_mod
+
+    from marl_distributedformation_tpu.utils import Throughput
+
+    meter = Throughput(window=4)
+    meter.tick(100)  # warmup tick: starts the clock only
+    for _ in range(10):
+        time_mod.sleep(0.01)
+        meter.tick(10)
+    rate = meter.rate()
+    # ~10 steps / 10ms = ~1000/s; generous bounds for CI jitter
+    assert 200 < rate < 5000, rate

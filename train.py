@@ -53,6 +53,7 @@ def train_config_from_config(cfg) -> TrainConfig:
         use_wandb=cfg.use_wandb,
         resume=cfg.get("resume", False),
         log_interval=cfg.log_interval,
+        profile=bool(cfg.get("profile", False)),
     )
 
 
