@@ -6,12 +6,22 @@ neighbors. The reference has nothing like it (its interaction graph is the
 static ring, simulate.py:162-167); this op is the new scaling axis for large
 swarms.
 
-TPU mapping: the pairwise squared-distance matrix is computed via the
-expansion |a_i - a_j|^2 = |a_i|^2 + |a_j|^2 - 2 a_i.a_j so the cross term is
-a single (N,2)x(2,N) matmul on the MXU, then ``jax.lax.top_k`` selects the k
-smallest per row. Everything is static-shaped and batches cleanly under
-``vmap`` — at the config-4 scale (M=4096, N=100) the distance matrices are
-~160 MFLOP/step, noise for the MXU.
+TPU mapping: the pairwise squared-distance matrix is computed in the direct
+broadcast form (x_i - x_j)^2 + (y_i - y_j)^2 — pure VPU elementwise work,
+fully fuseable — then ``jax.lax.top_k`` selects the k smallest per row.
+Everything is static-shaped and batches cleanly under ``vmap``.
+
+Why NOT the |a|^2 + |b|^2 - 2 a.b matmul expansion: TPU executes f32
+matmuls at bf16 input precision by default, and at world-coordinate scale
+~400 the expansion subtracts numbers of magnitude ~3e5 to recover
+differences of magnitude ~1 — the bf16 rounding of the cross term is
+amplified into real errors (measured round 2 on TPU v5e at M=4096, N=100,
+k=4: 33.5% wrong neighbor indices, distance errors up to 46 world units vs
+float64 ground truth). The direct form subtracts coordinates FIRST, so
+there is no cancellation and no matmul precision to worry about; at d=2
+the FLOP difference is noise. ``tests/tpu_compiled_parity.py`` pins this
+on hardware and ``tests/test_ops_pallas.py::test_xla_knn_precision`` pins
+it structurally (no dot_general in the lowering).
 """
 
 from __future__ import annotations
@@ -29,11 +39,12 @@ _SELF_MASK = 1e12
 
 
 def pairwise_sq_dists(points: Array) -> Array:
-    """Squared euclidean distance matrix ``(N, N)`` for ``points (N, d)``,
-    cross term on the MXU; the diagonal is masked to ``_SELF_MASK``."""
-    sq = (points**2).sum(-1)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * points @ points.T
-    d2 = jnp.maximum(d2, 0.0)  # clamp catastrophic-cancellation negatives
+    """Squared euclidean distance matrix ``(N, N)`` for ``points (N, d)``
+    in the direct broadcast form (coordinates subtracted BEFORE squaring —
+    exact in f32, no bf16-matmul cancellation; see module docstring); the
+    diagonal is masked to ``_SELF_MASK``."""
+    diff = points[:, None, :] - points[None, :, :]  # (N, N, d)
+    d2 = (diff * diff).sum(-1)
     return d2 + _SELF_MASK * jnp.eye(points.shape[0], dtype=points.dtype)
 
 
@@ -130,7 +141,9 @@ def knn_batch(
     materializes the ``(M, N, N)`` distance tensor in HBM;
     ``"pallas_interpret"`` — the same kernel in interpret mode (CPU tests);
     ``"auto"`` — pallas on TPU backends when the kernel's intermediates fit
-    VMEM (N up to ~700) AND the batch is not under SPMD-partitioner control
+    VMEM (N <= 640: 641 pads to 768 lanes and the ~6 live (1, 768, 768) f32
+    intermediates exceed the 12 MiB budget) AND the batch is not under
+    SPMD-partitioner control
     (a ``pallas_call`` is a Mosaic custom call the partitioner cannot split,
     so a dp-sharded batch traced under plain ``jit`` falls back to xla;
     inside ``shard_map`` — where the kernel sees its local block — pallas is

