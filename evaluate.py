@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Quantitative policy evaluation — the capability the reference lacks
+entirely (its only evaluation is watching animations, SURVEY.md §4).
+
+Rolls full episodes for M formations in one jitted scan and prints a
+comparison table: trained policy vs the scripted potential-field baseline
+(env/baseline.py = reference simulate.py:256-319) vs zero actions, on
+identical initial states. Emits one JSON line for machine consumption.
+
+Usage:
+    python evaluate.py name=myrun                  # latest checkpoint of run
+    python evaluate.py checkpoint=logs/x/rl_model_200_steps.ckpt
+    python evaluate.py name=myrun eval_formations=1024 eval_seed=7
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from marl_distributedformation_tpu.eval import (
+    baseline_act_fn,
+    evaluate,
+    evaluate_checkpoint,
+    zero_act_fn,
+)
+from marl_distributedformation_tpu.utils import (
+    env_params_from_config,
+    latest_checkpoint,
+    load_config,
+    repo_root,
+    setup_platform,
+)
+
+
+def main(argv=None) -> dict:
+    cfg = load_config(sys.argv[1:] if argv is None else argv)
+    setup_platform(cfg.get("platform"))
+    params = env_params_from_config(cfg)
+    m = int(cfg.get("eval_formations", 1024))
+    seed = int(cfg.get("eval_seed", 1234))
+
+    ckpt = cfg.get("checkpoint")
+    if not ckpt:
+        log_dir = str(repo_root() / "logs" / str(cfg.name))
+        ckpt = latest_checkpoint(log_dir)
+        if ckpt is None:
+            raise SystemExit(
+                f"no checkpoint under {log_dir}; pass checkpoint=... or "
+                "name=<trained run>"
+            )
+
+    rows = {
+        "policy": evaluate_checkpoint(str(ckpt), params, m, seed),
+        "baseline": evaluate(baseline_act_fn(params), params, m, seed),
+        "zero": evaluate(zero_act_fn(), params, m, seed),
+    }
+
+    cols = [
+        "episode_return_per_agent",
+        "final_avg_dist_to_goal",
+        "last100_avg_dist_to_goal",
+        "final_ave_dist_to_neighbor",
+    ]
+    name_w = max(len(k) for k in rows)
+    print(f"[eval] checkpoint: {ckpt}")
+    print(f"[eval] M={m} formations x N={params.num_agents} agents, "
+          f"seed={seed}, full episodes")
+    header = " | ".join(f"{c:>26}" for c in cols)
+    print(f"{'':<{name_w}} | {header}")
+    for name, r in rows.items():
+        vals = " | ".join(f"{r[c]:>26.2f}" for c in cols)
+        print(f"{name:<{name_w}} | {vals}")
+
+    result = {
+        "checkpoint": str(ckpt),
+        "eval_formations": m,
+        "num_agents": params.num_agents,
+        "seed": seed,
+        **{f"{name}_{c}": r[c] for name, r in rows.items() for c in cols},
+        "beats_baseline": bool(
+            rows["policy"]["episode_return_per_agent"]
+            > rows["baseline"]["episode_return_per_agent"]
+        ),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

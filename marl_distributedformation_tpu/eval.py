@@ -1,0 +1,163 @@
+"""Policy evaluation: full-episode returns on the device, no host loop.
+
+The reference has no quantitative evaluation at all — its only policy
+assessment is watching ``visualize_policy.py`` animations and wandb curves
+(SURVEY.md §4). This module adds the missing capability: roll complete
+episodes for M formations entirely inside one jitted ``lax.scan`` and reduce
+returns/metrics on-device, so a statistically meaningful evaluation (e.g.
+M=1024 formations x 1002 steps) takes well under a second on a TPU chip.
+
+The quantitative bar it enables (VERDICT.md r2 next-#2): compare a learned
+policy's mean episode return and final ``avg_dist_to_goal`` against the
+scripted potential-field baseline (env/baseline.py, the reference's
+``control`` — simulate.py:256-319) on the *same* initial states.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.env.baseline import control
+from marl_distributedformation_tpu.env.formation import (
+    compute_obs,
+    reset_batch,
+    step_batch,
+)
+
+Array = jax.Array
+
+# act_fn(agents (M,N,2), goal (M,2), obstacles (M,K,2), obs (M,N,obs_dim))
+#   -> velocities (M,N,2)  [RAW velocities — the L0 contract, SURVEY.md Q8]
+ActFn = Callable[[Array, Array, Array, Array], Array]
+
+
+def episode_length(params: EnvParams) -> int:
+    """Steps needed to cover one full episode from reset.
+
+    Under strict parity an episode is ``max_steps + 2`` steps (the
+    reference's off-by-one, SURVEY.md Q1: done fires when
+    steps_since_reset > max_steps with the check before the increment).
+    """
+    return params.max_steps + (2 if params.strict_parity else 0)
+
+
+@functools.partial(jax.jit, static_argnames=("act_fn", "params", "num_formations"))
+def _run_episodes(
+    key: Array, act_fn: ActFn, params: EnvParams, num_formations: int
+) -> Dict[str, Array]:
+    state = reset_batch(key, params, num_formations)
+    obs0 = compute_obs(state.agents, state.goal, params)
+    T = episode_length(params)
+
+    def body(carry, _):
+        state, obs = carry
+        vel = act_fn(state.agents, state.goal, state.obstacles, obs)
+        state, tr = step_batch(state, vel, params)
+        step_out = {
+            "reward": tr.reward.mean(),  # mean over formations x agents
+            "avg_dist_to_goal": tr.metrics["avg_dist_to_goal"].mean(),
+            "ave_dist_to_neighbor": tr.metrics["ave_dist_to_neighbor"].mean(),
+            "done": tr.done.sum(),
+        }
+        return (state, tr.obs), step_out
+
+    (_, _), out = jax.lax.scan(body, (state, obs0), None, length=T)
+    # The step where done fires auto-resets the state BEFORE metrics are
+    # computed (the reference's step order, simulate.py:113-117), so the
+    # scan's last row reports a fresh random formation. In BOTH parity and
+    # non-parity modes done fires on the scan's final row (episode_length
+    # accounts for the Q1 off-by-one), so the last in-episode metrics row
+    # is T-2.
+    last = T - 2
+    # Return denomination: per-agent episode return, the quantity SB3's
+    # rollout reward tracks (mean step reward x episode length). Rewards
+    # are computed on the pre-reset state, so every row counts.
+    return {
+        "episode_return_per_agent": out["reward"].sum(),
+        "mean_step_reward": out["reward"].mean(),
+        "final_avg_dist_to_goal": out["avg_dist_to_goal"][last],
+        "last100_avg_dist_to_goal": out["avg_dist_to_goal"][
+            last - 99 : last + 1
+        ].mean(),
+        "final_ave_dist_to_neighbor": out["ave_dist_to_neighbor"][last],
+        "episodes": out["done"].sum(),
+    }
+
+
+def evaluate(
+    act_fn: ActFn,
+    params: EnvParams,
+    num_formations: int = 1024,
+    seed: int = 1234,
+) -> Dict[str, float]:
+    """Run one full episode on M formations; returns host-side floats."""
+    out = _run_episodes(
+        jax.random.PRNGKey(seed), act_fn, params, num_formations
+    )
+    return {k: float(v) for k, v in out.items()}
+
+
+def baseline_act_fn(params: EnvParams) -> ActFn:
+    """The scripted potential-field controller as an ``ActFn``."""
+
+    def act(agents, goal, obstacles, obs):
+        del obs
+        return jax.vmap(control, in_axes=(0, 0, 0, None))(
+            agents, goal, obstacles, params
+        )
+
+    return act
+
+
+def policy_act_fn(
+    model, model_params, params: EnvParams, deterministic: bool = True
+) -> ActFn:
+    """A trained actor-critic as an ``ActFn``: mode action, clipped to the
+    [-1, 1] action space, scaled by max_speed (the L1 adapter semantics,
+    reference vectorized_env.py:69-70)."""
+    per_formation = getattr(model, "per_formation", False)
+
+    def act(agents, goal, obstacles, obs):
+        del agents, goal, obstacles
+        m = obs.shape[0]
+        if not per_formation:
+            flat = obs.reshape(-1, obs.shape[-1])
+            mean, _, _ = model.apply(model_params, flat)
+            mean = mean.reshape(m, -1, mean.shape[-1])
+        else:
+            mean, _, _ = model.apply(model_params, obs)
+        assert deterministic, "eval uses the deterministic mode action"
+        return params.max_speed * jnp.clip(mean, -1.0, 1.0)
+
+    return act
+
+
+def zero_act_fn() -> ActFn:
+    """Do-nothing control — the floor any learned policy must clear."""
+
+    def act(agents, goal, obstacles, obs):
+        del goal, obstacles, obs
+        return jnp.zeros_like(agents)
+
+    return act
+
+
+def evaluate_checkpoint(
+    checkpoint_path: str,
+    params: EnvParams,
+    num_formations: int = 1024,
+    seed: int = 1234,
+) -> Dict[str, float]:
+    """Restore a trainer checkpoint and evaluate its deterministic policy."""
+    from marl_distributedformation_tpu.compat.policy import LoadedPolicy
+
+    pol = LoadedPolicy.from_checkpoint(
+        checkpoint_path, act_dim=params.act_dim, env_params=params
+    )
+    act = policy_act_fn(pol.model, pol.params, params)
+    return evaluate(act, params, num_formations=num_formations, seed=seed)
