@@ -73,11 +73,26 @@ def apply_overrides(cfg: Dict[str, Any], overrides: Iterable[str]) -> None:
         target[parts[-1]] = _parse_value(raw)
 
 
+# Named hyperparameter presets (``preset=tpu`` on any entry point).
+# Precedence: YAML defaults < preset < explicit CLI overrides — so
+# ``python train.py preset=tpu batch_size=4096`` keeps the user's batch size.
+#
+# "tpu": the TPU-shaped training configuration. The parity defaults inherit
+# SB3's batch_size=64, which turns each update into n_epochs x (rollout/64)
+# *sequential* tiny SGD steps — at M=4096 that is 32,000 serial launches of
+# MXU-starving (64, obs_dim) matmuls, 98% of iteration wall-clock
+# (docs/profiling.md). batch_size=8192 keeps the same epochs/passes over the
+# data with 128x fewer, 128x larger steps — the shape the MXU wants.
+PRESETS: Dict[str, Dict[str, Any]] = {
+    "tpu": {"batch_size": 8192},
+}
+
+
 def load_config(
     overrides: Optional[List[str]] = None,
     config_path: str = "cfg/config.yaml",
 ) -> Config:
-    """Load the YAML config and apply CLI overrides.
+    """Load the YAML config and apply presets + CLI overrides.
 
     ``config_path`` is resolved relative to the repo root (this file's
     grandparent), so entry points work from any cwd — the equivalent of the
@@ -89,7 +104,22 @@ def load_config(
     with open(path) as f:
         data = yaml.safe_load(f) or {}
     cfg = _to_config(data)
-    apply_overrides(cfg, overrides or [])
+    overrides = list(overrides or [])
+    preset = next(
+        (
+            _parse_value(o.split("=", 1)[1])
+            for o in reversed(overrides)
+            if "=" in o and o.split("=", 1)[0] == "preset"
+        ),
+        data.get("preset"),
+    )  # a bare "preset" token falls through to apply_overrides' error
+    if preset:
+        if preset not in PRESETS:
+            raise ValueError(
+                f"unknown preset {preset!r}; available: {sorted(PRESETS)}"
+            )
+        cfg.update(_to_config(PRESETS[preset]))
+    apply_overrides(cfg, overrides)
     return cfg
 
 

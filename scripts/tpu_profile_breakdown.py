@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Record Trainer.profile_breakdown() on the real chip at the north-star
+shape, for parity and TPU-tuned hyperparameters (docs/profiling.md table;
+VERDICT.md r2 next-#3).
+
+Run: python scripts/tpu_profile_breakdown.py [M]
+Prints two markdown table rows + a JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+
+    import jax
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+
+    device = jax.devices()[0].device_kind
+    rows = {}
+    for label, ppo in (
+        ("parity (batch=64)", PPOConfig()),
+        ("preset=tpu (batch=8192)", PPOConfig(batch_size=8192)),
+    ):
+        trainer = Trainer(
+            EnvParams(num_agents=5),
+            ppo=ppo,
+            config=TrainConfig(
+                num_formations=m, checkpoint=False, name="profile"
+            ),
+        )
+        b = trainer.profile_breakdown(iters=5)
+        rows[label] = b
+        rate = ppo.n_steps * m / b["total"]
+        print(
+            f"| M={m} {label} | {b['total']*1e3:,.1f} ms | "
+            f"{b['env']*1e3:,.1f} ms | {b['policy']*1e3:,.1f} ms | "
+            f"{b['update']*1e3:,.1f} ms | {b['frac_update']*100:.1f}% | "
+            f"{rate:,.0f} |"
+        )
+    print(json.dumps({"device": device, "m": m, "breakdown": rows}))
+
+
+if __name__ == "__main__":
+    main()
