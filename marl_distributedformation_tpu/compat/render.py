@@ -1,6 +1,7 @@
 """Matplotlib renderer for a single formation — the reference's live view
 (simulate.py:33-67): world box, blue agent circles with thin ring edges, red
-goal circle, green obstacle rectangles. Pulls device state to host once per
+goal circle, green obstacle rectangles that flash red while an agent is
+inside them (simulate.py:101-106). Pulls device state to host once per
 frame; rendering never touches the compute path.
 """
 
@@ -11,6 +12,30 @@ from typing import Optional
 import numpy as np
 
 from marl_distributedformation_tpu.env import EnvParams
+
+
+def obstacle_hits(
+    agents: np.ndarray, obstacles: np.ndarray, params: EnvParams
+) -> np.ndarray:
+    """Per-obstacle collision flag ``(K,) bool``: any agent inside.
+
+    Host-side mirror of the env's containment geometry
+    (env/formation.py:_in_obstacle, reduced per obstacle instead of per
+    agent): ``parity`` mode uses the reference's lower-left-corner
+    ``obstacle_size`` box (SURVEY.md Q2), ``fixed`` mode the centered
+    ``2*obstacle_size`` box that matches placement and rendering.
+    ``tests/test_compat.py`` pins this against the env's jax implementation.
+    """
+    if obstacles.shape[0] == 0:
+        return np.zeros((0,), dtype=bool)
+    if params.obstacle_mode == "parity":
+        lo = obstacles[:, None, :]
+        hi = lo + params.obstacle_size
+    else:  # "fixed"
+        lo = obstacles[:, None, :] - params.obstacle_size
+        hi = obstacles[:, None, :] + params.obstacle_size
+    inside = (lo <= agents[None]) & (agents[None] <= hi)  # (K, N, 2)
+    return inside.all(axis=-1).any(axis=1)
 
 
 class FormationRenderer:
@@ -73,12 +98,18 @@ class FormationRenderer:
         for pos, nxt, line in zip(agents, ring, self.agent_lines):
             line.set_data([pos[0], nxt[0]], [pos[1], nxt[1]])
         self.goal_circle.center = (goal[0], goal[1])
-        if obstacles is not None:
-            for pos, rect in zip(obstacles, self.obstacle_rects):
+        if obstacles is not None and len(self.obstacle_rects) > 0:
+            # Collision feedback (simulate.py:101-106): an obstacle turns
+            # red while any agent is inside it, green otherwise.
+            hits = obstacle_hits(
+                np.asarray(agents), np.asarray(obstacles), self.params
+            )
+            for pos, hit, rect in zip(obstacles, hits, self.obstacle_rects):
                 rect.xy = (
                     pos[0] - self.params.obstacle_size,
                     pos[1] - self.params.obstacle_size,
                 )
+                rect.set_color("red" if hit else "green")
 
     def draw(self) -> None:
         self.fig.canvas.draw_idle()

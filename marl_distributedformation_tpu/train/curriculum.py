@@ -382,6 +382,7 @@ class HeteroTrainer:
             self.log_dir,
             run_name=self.config.name,
             use_wandb=self.config.use_wandb,
+            use_tensorboard=self.config.use_tensorboard,
         )
         meter = Throughput()
         last_record: Dict[str, float] = {}
@@ -412,8 +413,11 @@ class HeteroTrainer:
                         self.ppo.n_steps * self.config.num_formations
                     )
                     if iteration % self.config.log_interval == 0:
+                        # Single batched device_get — per-metric float()
+                        # pays one tunnel RTT per key (see Trainer.train).
+                        host_metrics = jax.device_get(metrics)
                         last_record = {
-                            k: float(v) for k, v in metrics.items()
+                            k: float(v) for k, v in host_metrics.items()
                         }
                         last_record["env_steps_per_sec"] = meter.rate()
                         last_record["curriculum_stage"] = float(stage_idx)

@@ -56,6 +56,9 @@ class TrainConfig:
     name: str = "default"
     log_dir: Optional[str] = None  # default <repo>/logs/{name}
     use_wandb: bool = False
+    use_tensorboard: bool = False  # SB3 writes tensorboard_log scalars
+    #   (reference vectorized_env.py:129); opt-in equivalent via torch's
+    #   SummaryWriter into {log_dir}/tensorboard/
     resume: bool = False
     log_interval: int = 1  # emit metrics every k rollouts
     profile: bool = False  # capture a jax.profiler trace of a few
@@ -276,6 +279,7 @@ class Trainer:
             self.log_dir,
             run_name=self.config.name,
             use_wandb=self.config.use_wandb,
+            use_tensorboard=self.config.use_tensorboard,
         )
         meter = Throughput()
         last_record: Dict[str, float] = {}
@@ -303,9 +307,14 @@ class Trainer:
                     profiling = False
                 meter.tick(self.ppo.n_steps * self.config.num_formations)
                 if iteration % self.config.log_interval == 0:
-                    # One host sync per log interval, after dispatch.
+                    # One host sync per log interval, after dispatch — a
+                    # single batched device_get, NOT per-metric float():
+                    # on a tunneled TPU each transfer pays full RTT, and
+                    # ~16 of them per iteration can cost more than the
+                    # iteration itself.
+                    host_metrics = jax.device_get(metrics)
                     last_record = {
-                        k: float(v) for k, v in metrics.items()
+                        k: float(v) for k, v in host_metrics.items()
                     }
                     last_record["env_steps_per_sec"] = meter.rate()
                     logger.log(last_record, self.num_timesteps)

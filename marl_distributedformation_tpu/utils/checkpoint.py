@@ -55,6 +55,11 @@ def save_checkpoint(
         # Dot-prefixed temp name so a torn write can never be picked up by
         # latest_checkpoint (which also filters on the .msgpack suffix).
         tmp = path.parent / f".{path.name}.tmp"
+        # Pull the whole tree in ONE batched transfer before serializing:
+        # to_bytes converts leaf-by-leaf, and on a tunneled TPU ~40 separate
+        # device->host round-trips can dominate the training loop (the
+        # reference-parity save_freq checkpoints every iteration).
+        target = jax.device_get(target)
         tmp.write_bytes(serialization.to_bytes(target))
         tmp.replace(path)  # atomic: no torn checkpoints (SURVEY.md §5)
     if jax.process_count() > 1:

@@ -4,8 +4,12 @@ Replaces the reference's wandb streaming (SURVEY.md §5): the reference calls
 ``wandb.log`` once per formation per step plus 7 times per step from the
 reward/metrics path (Q7 — thousands of network-bound calls per vec-step).
 Here metrics are reduced inside the jitted train step and emitted once per
-rollout to a JSONL file, stdout, and optionally wandb (if installed and
-enabled). Metric names preserve the reference's observability contract
+rollout to a JSONL file, stdout, and optionally wandb and/or tensorboard
+(if installed and enabled; SB3 also writes ``tensorboard_log`` scalars for
+the reference, vectorized_env.py:129 — ``use_tensorboard=True`` restores
+that capability via ``torch.utils.tensorboard``, no host-callback cost
+since emission stays per-rollout). Metric names preserve the reference's
+observability contract
 (``close_to_goal_reward``, ``reward_dist``, ``reward_right_neighbor``,
 ``reward_left_neighbor``, ``avg_dist_to_goal``, ``ave_dist_to_neighbor``,
 ``std_dist_to_neighbor``, ``reward`` — simulate.py:188-254,
@@ -29,6 +33,7 @@ class MetricsLogger:
         use_wandb: bool = False,
         wandb_project: str = "formation-rl",
         stdout_every: int = 10,
+        use_tensorboard: bool = False,
     ) -> None:
         from marl_distributedformation_tpu.parallel.distributed import (
             is_coordinator,
@@ -62,6 +67,20 @@ class MetricsLogger:
             except Exception as e:  # pragma: no cover - wandb optional
                 print(f"[metrics] wandb unavailable ({e}); using JSONL only")
 
+        self._tb = None
+        if use_tensorboard and self._active:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(
+                    log_dir=str(self.log_dir / "tensorboard")
+                )
+            except Exception as e:  # pragma: no cover - tb optional
+                print(
+                    f"[metrics] tensorboard unavailable ({e}); "
+                    "using JSONL only"
+                )
+
     def log(self, metrics: Dict[str, Any], step: int) -> None:
         """Emit one metrics record at ``step`` (agent-transitions)."""
         if not self._active:
@@ -72,6 +91,10 @@ class MetricsLogger:
         self._file.write(json.dumps(record) + "\n")
         if self._wandb is not None:
             self._wandb.log(record, step=int(step))
+        if self._tb is not None:
+            for k, v in record.items():
+                if k != "step":
+                    self._tb.add_scalar(k, v, int(step))
         self._emit_count += 1
         if self.stdout_every and self._emit_count % self.stdout_every == 1:
             brief = {
@@ -86,3 +109,5 @@ class MetricsLogger:
             self._file.close()
         if self._wandb is not None:
             self._wandb.finish()
+        if self._tb is not None:
+            self._tb.close()

@@ -1,5 +1,6 @@
 """Smoke tests for the driver entry points (bench.py, __graft_entry__.py)."""
 
+import pytest
 import sys
 from pathlib import Path
 
@@ -27,6 +28,7 @@ def test_bench_runner_compiles_and_steps():
     )
 
 
+@pytest.mark.slow
 def test_bench_emits_parseable_json_on_cpu(monkeypatch, capsys):
     """The one-JSON-line contract must survive any backend state: force the
     CPU fallback path with tiny shapes and parse the output."""
@@ -57,6 +59,7 @@ def test_graft_entry_compiles():
     assert np.isfinite(np.asarray(mean)).all()
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     graft.dryrun_multichip(8)
 

@@ -152,3 +152,99 @@ def test_keyboard_move_constructs():
     import keyboard_move
 
     keyboard_move.main(["num_agents=3"])  # plt.show returns under Agg
+
+
+def test_obstacle_hits_matches_env_geometry():
+    """The renderer's host-side containment mirror must agree with the
+    env's jax `_in_obstacle` in both geometry modes (reduced per-obstacle
+    vs per-agent, so cross-check through the any-collision scalar and a
+    hand-built fixture)."""
+    import jax.numpy as jnp
+
+    from marl_distributedformation_tpu.compat.render import obstacle_hits
+    from marl_distributedformation_tpu.env.formation import _in_obstacle
+
+    rng = np.random.default_rng(7)
+    for mode in ("parity", "fixed"):
+        params = EnvParams(num_agents=6, num_obstacles=3, obstacle_mode=mode)
+        for _ in range(20):
+            agents = rng.uniform(0, 500, (6, 2))
+            obstacles = rng.uniform(0, 500, (3, 2))
+            hits = obstacle_hits(agents, obstacles, params)
+            per_agent = np.asarray(
+                _in_obstacle(jnp.asarray(agents), jnp.asarray(obstacles), params)
+            )
+            assert hits.any() == per_agent.any(), mode
+    # Fixture: agent dead-center in obstacle 0 only.
+    params = EnvParams(num_agents=2, num_obstacles=2, obstacle_mode="fixed")
+    hits = obstacle_hits(
+        np.array([[100.0, 100.0], [250.0, 250.0]]),
+        np.array([[100.0, 100.0], [400.0, 400.0]]),
+        params,
+    )
+    assert hits.tolist() == [True, False]
+    # Parity geometry: point is the lower-left corner (SURVEY.md Q2), so an
+    # agent just below/left of the point is NOT inside.
+    params = EnvParams(num_agents=2, num_obstacles=1, obstacle_mode="parity")
+    far = [250.0, 250.0]
+    assert obstacle_hits(
+        np.array([[99.0, 99.0], far]), np.array([[100.0, 100.0]]), params
+    ).tolist() == [False]
+    assert obstacle_hits(
+        np.array([[101.0, 101.0], far]), np.array([[100.0, 100.0]]), params
+    ).tolist() == [True]
+
+
+def test_renderer_collision_recolor():
+    """Obstacle rectangles flip red while an agent is inside and back to
+    green when it leaves (reference simulate.py:101-106)."""
+    import matplotlib
+
+    from marl_distributedformation_tpu.compat.render import FormationRenderer
+
+    params = EnvParams(num_agents=2, num_obstacles=2, obstacle_mode="fixed")
+    r = FormationRenderer(params)
+    obstacles = np.array([[100.0, 100.0], [400.0, 400.0]])
+    goal = np.array([250.0, 250.0])
+    red = matplotlib.colors.to_rgba("red")
+    green = matplotlib.colors.to_rgba("green")
+
+    r.update(np.array([[100.0, 100.0], [10.0, 10.0]]), goal, obstacles)
+    assert r.obstacle_rects[0].get_facecolor() == red
+    assert r.obstacle_rects[1].get_facecolor() == green
+
+    r.update(np.array([[10.0, 10.0], [20.0, 20.0]]), goal, obstacles)
+    assert r.obstacle_rects[0].get_facecolor() == green
+    assert r.obstacle_rects[1].get_facecolor() == green
+
+
+def test_simulate_obstacle_demo_headless(capsys):
+    import simulate
+
+    simulate.main(
+        [
+            "headless=true",
+            "steps=30",
+            "num_agents=4",
+            "num_obstacles=4",
+            "obstacle_mode=fixed",
+            "seed=3",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "obstacle_hits=" in out
+
+
+def test_metrics_logger_tensorboard(tmp_path):
+    """use_tensorboard writes SB3-style event files (the reference's
+    tensorboard_log capability, vectorized_env.py:129)."""
+    pytest.importorskip("torch.utils.tensorboard")
+    from marl_distributedformation_tpu.utils import MetricsLogger
+
+    logger = MetricsLogger(tmp_path, use_tensorboard=True)
+    logger.log({"reward": 1.5, "loss": 0.3}, step=100)
+    logger.close()
+    tb_dir = tmp_path / "tensorboard"
+    assert any(
+        f.name.startswith("events.out.tfevents") for f in tb_dir.iterdir()
+    )

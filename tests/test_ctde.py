@@ -6,6 +6,7 @@ mask semantics for padded formations and an end-to-end trainer smoke run at
 20 agents.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -79,6 +80,7 @@ def test_mask_excludes_padded_agents():
     )
 
 
+@pytest.mark.slow
 def test_trainer_ctde_20_agents():
     env_params = EnvParams(num_agents=20)
     ppo = PPOConfig(n_steps=4, n_epochs=2, batch_size=80)

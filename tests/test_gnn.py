@@ -1,5 +1,6 @@
 """k-NN observation graph + GNN policy tests (BASELINE.json config 4)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -167,6 +168,7 @@ def test_gather_nodes():
     )
 
 
+@pytest.mark.slow
 def test_trainer_gnn_smoke():
     env_params = EnvParams(num_agents=16, obs_mode="knn", knn_k=4)
     model = GNNActorCritic(k=4, rounds=2)
@@ -234,6 +236,7 @@ def test_knn_batch_auto_on_sharded_input_runs():
     )
 
 
+@pytest.mark.slow
 def test_dp_step_shard_map_runs_kernel_on_local_blocks(tmp_path):
     """Trainer with a dp mesh + knn obs uses the shard_map-wrapped env step;
     forcing the (interpret-mode) Pallas kernel inside it must reproduce the

@@ -313,6 +313,7 @@ class TestCurriculum:
 
 
 class TestHeteroTrainer:
+    @pytest.mark.slow
     def test_short_curriculum_run(self, tmp_path):
         cur = Curriculum(
             stages=(
@@ -344,6 +345,7 @@ class TestHeteroTrainer:
         # active-agent timestep accounting: stage rollouts * n_steps * sum(n)
         assert trainer.num_timesteps > 0
 
+    @pytest.mark.slow
     def test_resume_skips_completed_stages(self, tmp_path):
         cur = Curriculum(
             stages=(
@@ -383,6 +385,7 @@ class TestHeteroTrainer:
         )
         assert record["curriculum_stage"] == 1.0
 
+    @pytest.mark.slow
     def test_sharded_hetero_trainer(self, tmp_path):
         """Curriculum training with the formation axis sharded over 'dp'
         (the cfg.mesh path): stage transitions must re-place the fresh env
@@ -442,6 +445,7 @@ class TestMaskedCTDE:
             advantages=adv, returns=ret, weights=w, mask=w,
         )
 
+    @pytest.mark.slow
     def test_update_padding_invariance(self):
         from marl_distributedformation_tpu.models import CTDEActorCritic
 

@@ -41,6 +41,7 @@ def _trainer(tmp_path, shard_fn=None, num_formations=8):
     )
 
 
+@pytest.mark.slow
 def test_sharded_training_matches_single_device(tmp_path):
     """dp-sharded training is numerically the same program: metrics and
     updated params must match the unsharded run to fp32 tolerance."""
@@ -94,6 +95,7 @@ from marl_distributedformation_tpu.parallel import make_ring_step, place_ring_st
 
 
 @pytest.mark.parametrize("dp,sp", [(1, 8), (2, 4), (4, 2), (8, 1)])
+@pytest.mark.slow
 def test_ring_step_matches_unsharded(dp, sp):
     """Agent-axis sharding is semantics-free: ring-step trajectories equal
     the unsharded vmap step exactly (same reset draws, same rewards/obs)."""
@@ -176,6 +178,7 @@ def _sp_trainer(tmp_path, shard_fn=None):
     )
 
 
+@pytest.mark.slow
 def test_sp_sharded_training_matches_single_device(tmp_path):
     """Full train iterations on a {dp:2, sp:2} mesh: the halo-exchange env
     step + sharded PPO update must reproduce the unsharded trajectory (env

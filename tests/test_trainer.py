@@ -79,6 +79,7 @@ def test_checkpoint_write_discovery_resume(tmp_path):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow
 def test_trainer_deterministic_under_seed(tmp_path):
     t1 = tiny_trainer(tmp_path / "a", checkpoint=False)
     t2 = tiny_trainer(tmp_path / "b", checkpoint=False)
@@ -190,6 +191,7 @@ def test_profile_flag_writes_trace(tmp_path):
     assert any(f.is_file() for f in files), "trace directory is empty"
 
 
+@pytest.mark.slow
 def test_profile_breakdown(tmp_path):
     trainer = tiny_trainer(tmp_path, checkpoint=False)
     bd = trainer.profile_breakdown(iters=2)

@@ -51,6 +51,7 @@ def train_config_from_config(cfg) -> TrainConfig:
         name=run_name,
         log_dir=str(repo_root() / "logs" / run_name),
         use_wandb=cfg.use_wandb,
+        use_tensorboard=bool(cfg.get("use_tensorboard", False)),
         resume=cfg.get("resume", False),
         log_interval=cfg.log_interval,
         profile=bool(cfg.get("profile", False)),

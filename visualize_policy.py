@@ -6,7 +6,10 @@ formation with deterministic actions, render and print every transition.
 
 Extras: ``headless=true`` runs without a display, ``steps=N`` limits the
 horizon, ``platform=cpu`` keeps playback off the TPU (recommended — it is a
-single formation).
+single formation), and ``gif=docs/demo.gif`` records the playback to an
+animated gif instead of opening a window (the reference ships a committed
+``animation.gif`` in its README; this is how ours is produced —
+``gif_every=K`` subsamples to every K-th step to keep the file small).
 """
 
 from __future__ import annotations
@@ -48,21 +51,49 @@ def main(argv=None) -> None:
 
     steps = int(cfg.get("steps", 1000))
     headless = bool(cfg.get("headless", False))
+    gif = cfg.get("gif")
+    quiet = bool(gif)  # gif recording skips the per-step transition dump
 
     def playback_step(i, obs):
-        print("-" * 10)
-        print(f"Step {i}")
+        if not quiet:
+            print("-" * 10)
+            print(f"Step {i}")
         actions, _ = policy.predict(obs, deterministic=True)
-        print(f"actions: {actions}")
         obs, rewards, dones, _ = env.step(actions)
-        print(f"obs: {obs}")
-        print(f"rewards: {rewards}")
-        print(f"dones: {dones}")
+        if not quiet:
+            print(f"actions: {actions}")
+            print(f"obs: {obs}")
+            print(f"rewards: {rewards}")
+            print(f"dones: {dones}")
         return obs
 
-    if headless:
+    if headless and not gif:
         for i in range(steps):
             obs = playback_step(i, obs)
+        return
+
+    if gif:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from matplotlib.animation import PillowWriter
+
+        from marl_distributedformation_tpu.compat.render import (
+            FormationRenderer,
+        )
+
+        every = int(cfg.get("gif_every", 5))
+        renderer = FormationRenderer(params, title=f"policy: {path.name}")
+        writer = PillowWriter(fps=int(cfg.get("gif_fps", 20)))
+        with writer.saving(renderer.fig, str(gif), dpi=60):
+            for i in range(steps):
+                obs = playback_step(i, obs)
+                if i % every == 0:
+                    renderer.update(
+                        env.agents_np(), env.goal_np(), env.obstacles_np()
+                    )
+                    writer.grab_frame()
+        print(f"wrote {steps // every} frames to {gif}")
         return
 
     import matplotlib.animation as animation
