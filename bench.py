@@ -174,12 +174,20 @@ def _time_train_phase(n_agents: int, m: int, deadline: float, ppo=None):
     metrics = trainer.run_iteration()  # warmup: compile + 1 exec
     float(metrics["loss"])
 
+    # Sync once per BURST of iterations, not per iteration: a host sync
+    # pays a full tunnel RTT, which at tuned-config speeds (~84 ms/iter)
+    # would be a material fraction of every iteration. XLA executions on
+    # one device are serialized, so syncing the last iteration's metrics
+    # times the whole burst; the burst is small enough that the dispatch
+    # queue stays bounded.
+    burst = 8
     iters = 0
     t0 = time.perf_counter()
     while True:
-        metrics = trainer.run_iteration()
-        float(metrics["loss"])  # host sync
-        iters += 1
+        for _ in range(burst):
+            metrics = trainer.run_iteration()
+        float(metrics["loss"])  # host sync for the whole burst
+        iters += burst
         elapsed = time.perf_counter() - t0
         if elapsed >= MIN_TIMED_S or time.time() > deadline or iters >= 256:
             break

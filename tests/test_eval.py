@@ -1,0 +1,117 @@
+"""Evaluation harness (eval.py / evaluate.py): episode accounting and the
+policy-vs-baseline comparison contract."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.eval import (
+    baseline_act_fn,
+    episode_length,
+    evaluate,
+    policy_act_fn,
+    zero_act_fn,
+)
+
+
+def short_params(**kw):
+    return EnvParams(num_agents=4, max_steps=30, **kw)
+
+
+def test_episode_length_parity_modes():
+    assert episode_length(short_params()) == 32  # Q1 off-by-one
+    assert episode_length(short_params(strict_parity=False)) == 30
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_exactly_one_episode_and_pre_reset_final_metrics(strict):
+    """Every formation finishes exactly one episode, and the reported
+    final metrics come from the last pre-reset step (the done row's
+    metrics describe a fresh formation — reference step order,
+    simulate.py:113-117)."""
+    params = short_params(strict_parity=strict)
+    out = evaluate(zero_act_fn(), params, num_formations=8, seed=5)
+    assert out["episodes"] == 8.0
+    # Zero actions: agents spawn in the bottom strip, goal is far — the
+    # pre-reset distance must reflect that scattered start, not a
+    # post-reset re-randomization that could accidentally be closer.
+    assert out["final_avg_dist_to_goal"] > 100.0
+
+
+def test_baseline_beats_zero_actions():
+    # N=10, the reference's own demo size (simulate.py:324). At very small
+    # N the scripted controller's radius-40 spacing (Q11) lands deep in the
+    # reward's quadratic too-close penalty and actually scores WORSE than
+    # zero actions — e.g. N=4: spacing 31.4 vs desired 84.9 is ~-57/step.
+    params = EnvParams(num_agents=10, max_steps=300)
+    base = evaluate(baseline_act_fn(params), params, num_formations=8)
+    zero = evaluate(zero_act_fn(), params, num_formations=8)
+    assert (
+        base["episode_return_per_agent"] > zero["episode_return_per_agent"]
+    )
+    assert base["final_avg_dist_to_goal"] < zero["final_avg_dist_to_goal"]
+
+
+def test_policy_act_fn_scales_and_clips():
+    """The policy ActFn applies the L1 adapter semantics: mode action
+    clipped to [-1, 1] then scaled by max_speed (vectorized_env.py:69-70)."""
+
+    class HugeMean:
+        per_formation = False
+
+        def apply(self, params, obs):
+            mean = jnp.full((obs.shape[0], 2), 7.0)
+            return mean, jnp.zeros(2), jnp.zeros(obs.shape[0])
+
+    params = short_params()
+    act = policy_act_fn(HugeMean(), {}, params)
+    obs = jnp.zeros((3, params.num_agents, params.obs_dim))
+    vel = act(None, None, None, obs)
+    np.testing.assert_allclose(np.asarray(vel), params.max_speed)
+
+
+def test_evaluate_cli_roundtrip(tmp_path, monkeypatch, capsys):
+    """evaluate.py discovers the latest checkpoint of a named run and
+    emits the machine-readable JSON line with the comparison fields."""
+    import sys
+
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import evaluate as evaluate_cli
+    import train as train_cli
+
+    monkeypatch.setattr(
+        "marl_distributedformation_tpu.utils.repo_root", lambda: tmp_path
+    )
+    train_cli.main(
+        [
+            "name=evalrun",
+            "num_formation=4",
+            "total_timesteps=800",
+            "max_steps=20",
+            "strict_parity=false",
+        ]
+    )
+    result = evaluate_cli.main(
+        [
+            "name=evalrun",
+            "eval_formations=4",
+            "max_steps=20",
+            "strict_parity=false",
+        ]
+    )
+    out = capsys.readouterr().out
+    last_json = json.loads(out.strip().splitlines()[-1])
+    for key in (
+        "policy_episode_return_per_agent",
+        "baseline_episode_return_per_agent",
+        "zero_episode_return_per_agent",
+        "beats_baseline",
+    ):
+        assert key in last_json, key
+    assert result["eval_formations"] == 4
