@@ -186,8 +186,10 @@ def _time_train_phase(n_agents: int, m: int, deadline: float, ppo=None):
     while True:
         for _ in range(burst):
             metrics = trainer.run_iteration()
+            iters += 1
+            if time.time() > deadline:  # pure wall-clock, no host sync —
+                break  # keep deadline responsiveness per-iteration
         float(metrics["loss"])  # host sync for the whole burst
-        iters += burst
         elapsed = time.perf_counter() - t0
         if elapsed >= MIN_TIMED_S or time.time() > deadline or iters >= 256:
             break

@@ -184,14 +184,28 @@ def compute_obs_knn(agents: Array, goal: Array, params: EnvParams) -> Array:
     """
     from marl_distributedformation_tpu.ops import knn, knn_batch
 
-    wh = jnp.array([params.width, params.height], dtype=jnp.float32)
-    diag = float(np.hypot(params.width, params.height))
     if agents.ndim > 2:
         idx, offsets, dists = knn_batch(
             agents, params.knn_k, impl=params.knn_impl
         )
     else:
         idx, offsets, dists = knn(agents, params.knn_k)
+    return _assemble_knn_obs(agents, goal, idx, offsets, dists, params)
+
+
+def _assemble_knn_obs(
+    agents: Array,
+    goal: Array,
+    idx: Array,
+    offsets: Array,
+    dists: Array,
+    params: EnvParams,
+) -> Array:
+    """The knn observation layout, given the search results — shared by the
+    single-device path above and the agent-axis-sharded path
+    (``compute_obs_knn_sharded``), so the two stay bit-identical."""
+    wh = jnp.array([params.width, params.height], dtype=jnp.float32)
+    diag = float(np.hypot(params.width, params.height))
     parts = [
         agents / wh,
         (offsets / wh).reshape(*agents.shape[:-1], 2 * params.knn_k),
@@ -201,6 +215,30 @@ def compute_obs_knn(agents: Array, goal: Array, params: EnvParams) -> Array:
         parts.append((goal[..., None, :] - agents) / wh)
     parts.append(idx.astype(jnp.float32))
     return jnp.concatenate(parts, axis=-1)
+
+
+def compute_obs_knn_sharded(
+    local_agents: Array,
+    all_agents: Array,
+    goal: Array,
+    params: EnvParams,
+    agent_offset,
+) -> Array:
+    """knn observations for an agent-axis-sharded slab (parallel/ring.py
+    swarm mode): ``local_agents (m, n_local, 2)`` is this device's slab of
+    global rows ``agent_offset..agent_offset+n_local``, ``all_agents
+    (m, N, 2)`` the all-gathered formation. Neighbor indices in the obs stay
+    GLOBAL, so the observation rows equal the corresponding rows of
+    ``compute_obs_knn`` on the unsharded formation exactly.
+    """
+    from marl_distributedformation_tpu.ops.knn import knn_local
+
+    idx, offsets, dists = jax.vmap(
+        knn_local, in_axes=(0, 0, None, None)
+    )(local_agents, all_agents, params.knn_k, agent_offset)
+    return _assemble_knn_obs(
+        local_agents, goal, idx, offsets, dists, params
+    )
 
 
 def _in_obstacle(agents: Array, obstacles: Array, params: EnvParams) -> Array:

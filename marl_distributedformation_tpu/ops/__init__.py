@@ -3,5 +3,6 @@
 from marl_distributedformation_tpu.ops.knn import (  # noqa: F401
     knn,
     knn_batch,
+    knn_local,
     pairwise_sq_dists,
 )

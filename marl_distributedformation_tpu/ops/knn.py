@@ -71,20 +71,59 @@ def knn(
     """
     n = points.shape[0]
     assert k < n, f"knn needs k < N (k={k}, N={n})"
+    if valid is None:
+        # The full search IS the local-query search with every point as a
+        # query — a single implementation keeps the sharded/unsharded
+        # bit-parity invariant true by construction (parallel/ring.py).
+        return knn_local(points, points, k, 0)
     d2 = pairwise_sq_dists(points)
-    if valid is not None:
-        d2 = jnp.where(valid[None, :], d2, _SELF_MASK)
+    d2 = jnp.where(valid[None, :], d2, _SELF_MASK)
     neg, idx = jax.lax.top_k(-d2, k)
     idx = idx.astype(jnp.int32)
-    if valid is not None:
-        # Slots that resolved into the masked region (self or invalid
-        # columns, all at _SELF_MASK) become explicit self-loops.
-        real = -neg < 0.5 * _SELF_MASK
-        idx = jnp.where(real, idx, jnp.arange(n, dtype=jnp.int32)[:, None])
+    # Slots that resolved into the masked region (self or invalid
+    # columns, all at _SELF_MASK) become explicit self-loops.
+    real = -neg < 0.5 * _SELF_MASK
+    idx = jnp.where(real, idx, jnp.arange(n, dtype=jnp.int32)[:, None])
     offsets = points[idx] - points[:, None, :]
     dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
-    if valid is not None:
-        dists = jnp.where(real, dists, 0.0)
+    dists = jnp.where(real, dists, 0.0)
+    return idx, offsets, dists
+
+
+def knn_local(
+    queries: Array,
+    points: Array,
+    k: int,
+    query_offset,
+) -> Tuple[Array, Array, Array]:
+    """k nearest neighbors of a LOCAL block of query agents against the
+    full point set — the agent-axis-sharded search (parallel/ring.py swarm
+    mode): each device holds ``queries (nq, d)`` (its slab of the formation,
+    global rows ``query_offset .. query_offset+nq``) and the all-gathered
+    ``points (N, d)``.
+
+    Distances are computed in the same direct broadcast form and the same
+    column order as :func:`knn`, so the selected indices/distances are
+    bit-identical to the corresponding rows of the unsharded search (no
+    tie-break divergence between sharded and unsharded trajectories).
+
+    Returns ``(idx (nq, k) int32 GLOBAL indices, offsets (nq, k, d),
+    dists (nq, k))`` sorted by ascending distance.
+    """
+    nq = queries.shape[0]
+    n = points.shape[0]
+    assert k < n, f"knn_local needs k < N (k={k}, N={n})"
+    diff = queries[:, None, :] - points[None, :, :]  # (nq, N, d)
+    d2 = (diff * diff).sum(-1)
+    # Self-mask by GLOBAL index: local query row j is global row
+    # query_offset + j.
+    gids = query_offset + jnp.arange(nq, dtype=jnp.int32)
+    cols = jnp.arange(n, dtype=jnp.int32)
+    d2 = jnp.where(cols[None, :] == gids[:, None], _SELF_MASK, d2)
+    neg, idx = jax.lax.top_k(-d2, k)
+    idx = idx.astype(jnp.int32)
+    offsets = points[idx] - queries[:, None, :]
+    dists = jnp.sqrt(jnp.maximum(-neg, 0.0))
     return idx, offsets, dists
 
 

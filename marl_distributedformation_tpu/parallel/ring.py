@@ -11,6 +11,15 @@ all-gathering the formation. Per step each device exchanges three halos
 ``m_local`` rows each, independent of N — swarm size scales linearly with
 devices at constant ICI traffic per device.
 
+``obs_mode="knn"`` swarms shard on 'sp' too (round 3): reward mixing and
+metrics keep the constant-traffic ring halos, while the observation's
+global neighbor search all-gathers positions over 'sp' (the all-to-all
+analog of sequence parallelism — positions are 8N bytes/formation, tiny
+next to the O(N·k) obs the search produces, which stay local) and each
+device runs the LOCAL-QUERY search ``ops.knn.knn_local`` for its slab.
+Sharded and unsharded trajectories coincide bit-for-bit
+(tests/test_parallel.py).
+
 The env math itself is NOT reimplemented here: ``env.formation``'s
 ``compute_obs`` / ``compute_reward`` / ``integrate`` are shape-generic and
 parameterized over a ``neighbors_fn``; this module supplies the halo-exchange
@@ -32,6 +41,7 @@ from marl_distributedformation_tpu.env import EnvParams, FormationState, Transit
 from marl_distributedformation_tpu.env.formation import (
     _in_obstacle,
     compute_obs,
+    compute_obs_knn_sharded,
     compute_reward,
     integrate,
     reset,
@@ -72,10 +82,11 @@ def make_ring_step(params: EnvParams, mesh: Mesh):
     outputs P('dp','sp'); per-formation outputs P('dp').
     """
     sp_size = mesh.shape["sp"]
-    if params.obs_mode != "ring":
+    if params.obs_mode not in ("ring", "knn"):
         raise ValueError(
-            "agent-axis ('sp') sharding requires obs_mode='ring' — knn "
-            "observations need the whole formation; use 'dp'-only meshes"
+            f"agent-axis ('sp') sharding supports obs_mode 'ring' (halo "
+            f"exchange) and 'knn' (all-gather + local-query search); got "
+            f"{params.obs_mode!r}"
         )
     if params.num_agents % sp_size != 0:
         raise ValueError(
@@ -144,11 +155,23 @@ def make_ring_step(params: EnvParams, mesh: Mesh):
         new_key = jnp.where(done[:, None], fresh.key, key)
 
         # Exchange #3: post-reset positions, reused by both the observation
-        # and the neighbor-distance metrics.
+        # (ring mode) and the neighbor-distance metrics (both modes).
         post_neighbors = neighbors_fn(new_agents, 1)
-        obs = compute_obs(
-            new_agents, new_goal, params, pos_neighbors=post_neighbors
-        )
+        if params.obs_mode == "knn":
+            # All-to-all analog: gather the full formation's positions over
+            # the 'sp' ring (8N bytes/formation — the cheap side of the
+            # problem), search locally for this device's slab. Indices in
+            # the obs stay global, so rows match the unsharded obs exactly.
+            all_pos = lax.all_gather(
+                new_agents, "sp", axis=1, tiled=True
+            )  # (m, N, 2)
+            obs = compute_obs_knn_sharded(
+                new_agents, all_pos, new_goal, params, sp_idx * n_local
+            )
+        else:
+            obs = compute_obs(
+                new_agents, new_goal, params, pos_neighbors=post_neighbors
+            )
 
         # Metrics (simulate.py:238-254) with global psum reductions; the
         # variance uses the numerically-stable centered form (two passes)

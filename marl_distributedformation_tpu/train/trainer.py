@@ -112,8 +112,10 @@ class Trainer:
 
         self._shard_fn = shard_fn
         # Agent-axis ('sp') sharding: swap the vmapped env step for the
-        # halo-exchange ring step (parallel/ring.py) so large swarms roll
-        # with N split across devices — constant per-device ICI traffic.
+        # sharded step (parallel/ring.py) so large swarms roll with N split
+        # across devices — ring obs exchange one-agent halos (constant
+        # per-device ICI traffic); knn obs all-gather positions and search
+        # locally per slab.
         self._env_step_fn = None
         mesh = getattr(shard_fn, "mesh", None)
         if mesh is not None and "sp" in mesh.shape:

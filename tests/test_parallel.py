@@ -226,13 +226,98 @@ def test_sp_shard_fn_layout(tmp_path):
     assert tuple(spec_after)[:2] == ("dp", "sp")
 
 
-def test_sp_shard_fn_rejects_knn_obs(tmp_path):
-    with pytest.raises(ValueError, match="sp"):
+def test_sp_shard_fn_accepts_knn_obs(tmp_path):
+    """Round 3: knn swarms shard on 'sp' too (all-gather + local-query
+    search). The Trainer selects the sharded step and one iteration runs;
+    an indivisible agent count is still rejected."""
+    trainer = Trainer(
+        EnvParams(num_agents=8, obs_mode="knn", knn_k=2, knn_impl="xla"),
+        config=TrainConfig(
+            num_formations=4, checkpoint=False,
+            log_dir=str(tmp_path / "logs"),
+        ),
+        shard_fn=make_shard_fn({"dp": 2, "sp": 2}),
+    )
+    assert trainer._env_step_fn is not None
+    assert np.isfinite(trainer.run_iteration()["loss"])
+    with pytest.raises(ValueError, match="divisible"):
         Trainer(
-            EnvParams(num_agents=8, obs_mode="knn", knn_k=2),
+            EnvParams(num_agents=7, obs_mode="knn", knn_k=2),
             config=TrainConfig(
                 num_formations=4, checkpoint=False,
-                log_dir=str(tmp_path / "logs"),
+                log_dir=str(tmp_path / "logs2"),
             ),
             shard_fn=make_shard_fn({"dp": 2, "sp": 2}),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Agent-axis sharding of knn swarms: all-gather + local-query search
+# ---------------------------------------------------------------------------
+
+
+def test_knn_local_matches_full_search():
+    """knn_local on a slab returns exactly the corresponding rows of the
+    full search (global indices, same tie-breaks — both use the identical
+    distance expression and column order)."""
+    from marl_distributedformation_tpu.ops import knn, knn_local
+
+    pts = jnp.asarray(
+        np.random.default_rng(3).uniform(0, 400, (12, 2)), jnp.float32
+    )
+    idx_full, off_full, d_full = knn(pts, 3)
+    for offset, nq in ((0, 4), (4, 4), (8, 4), (3, 6)):
+        idx, off, d = knn_local(pts[offset : offset + nq], pts, 3, offset)
+        np.testing.assert_array_equal(
+            np.asarray(idx), np.asarray(idx_full[offset : offset + nq])
+        )
+        np.testing.assert_allclose(
+            np.asarray(off), np.asarray(off_full[offset : offset + nq]),
+            rtol=1e-6, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(d), np.asarray(d_full[offset : offset + nq]),
+            rtol=1e-6, atol=1e-6,
+        )
+
+
+@pytest.mark.parametrize("dp,sp", [(2, 4), (1, 8)])
+@pytest.mark.slow
+def test_knn_ring_step_matches_unsharded(dp, sp):
+    """The sp-sharded knn swarm step (all-gather positions + knn_local per
+    slab + halo-exchange reward mixing) reproduces the unsharded
+    trajectory exactly — including the global neighbor indices carried in
+    the observations."""
+    params = EnvParams(
+        num_agents=16, max_steps=3, obs_mode="knn", knn_k=3,
+        knn_impl="xla",
+    )
+    M = 4 * dp if dp > 1 else 4
+    mesh = make_mesh({"dp": dp, "sp": sp})
+    ring_step = make_ring_step(params, mesh)
+
+    state_ref = reset_batch(jax.random.PRNGKey(7), params, M)
+    state_ring = place_ring_state(state_ref, mesh)
+
+    rng = np.random.default_rng(11)
+    for t in range(8):  # crosses the strict-parity auto-reset
+        vel = jnp.asarray(
+            rng.uniform(-10, 10, (M, 16, 2)).astype(np.float32)
+        )
+        state_ref, tr_ref = step_batch(state_ref, vel, params)
+        state_ring, tr_ring = ring_step(state_ring, vel)
+        np.testing.assert_allclose(
+            np.asarray(tr_ring.obs), np.asarray(tr_ref.obs),
+            rtol=1e-5, atol=1e-6, err_msg=f"obs t={t}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(tr_ring.reward), np.asarray(tr_ref.reward),
+            rtol=1e-4, atol=1e-4, err_msg=f"reward t={t}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tr_ring.done), np.asarray(tr_ref.done)
+        )
+        np.testing.assert_allclose(
+            np.asarray(state_ring.agents), np.asarray(state_ref.agents),
+            rtol=1e-5, atol=1e-5,
         )
