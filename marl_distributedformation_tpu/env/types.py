@@ -79,9 +79,10 @@ class EnvParams:
 
     knn_impl: str = "auto"
     """Neighbor-search implementation for batched knn observations:
-    ``"auto"`` (fused Pallas kernel on TPU, XLA elsewhere), ``"xla"``,
-    ``"pallas"``, or ``"pallas_interpret"`` (CPU-debuggable kernel).
-    See ops/knn.py ``knn_batch``."""
+    ``"auto"`` (on TPU: fused Pallas kernel for N <= 640, chunked-streaming
+    kernel beyond; XLA elsewhere), ``"xla"``, ``"pallas"``,
+    ``"pallas_big"``, or ``"pallas_interpret"``/``"pallas_big_interpret"``
+    (CPU-debuggable kernels). See ops/knn.py ``knn_batch``."""
 
     obstacle_mode: str = "parity"
     """``"parity"``: the reference's inconsistent geometry (Q2) — the obstacle
@@ -106,7 +107,9 @@ class EnvParams:
             "auto",
             "xla",
             "pallas",
+            "pallas_big",
             "pallas_interpret",
+            "pallas_big_interpret",
         ), f"unknown knn_impl {self.knn_impl!r}"
 
     @property

@@ -129,19 +129,27 @@ def knn_local(
 
 def _resolve_auto_impl(points: Array) -> str:
     """The ``impl="auto"`` dispatch predicate, factored out so tests can
-    pin the backend: pallas only on TPU, only when the intermediates fit
-    VMEM, and only when the SPMD partitioner does NOT control the batch."""
-    from marl_distributedformation_tpu.ops.knn_pallas import fits_vmem
-
-    return (
-        "pallas"
-        if (
-            jax.default_backend() == "tpu"
-            and fits_vmem(points.shape[1])
-            and not _spmd_partitioner_controlled(points)
-        )
-        else "xla"
+    pin the backend: on TPU, the fused kernel when the whole per-formation
+    problem fits VMEM (N <= 640), the chunked-streaming kernel beyond that
+    (no N ceiling); xla on other backends or when the SPMD partitioner
+    controls the batch (a pallas_call is a Mosaic custom call it cannot
+    split; shard_map-wrapped callers re-enter with local blocks)."""
+    from marl_distributedformation_tpu.ops.knn_pallas import (
+        fits_big_kernel,
+        fits_vmem,
     )
+
+    if jax.default_backend() != "tpu" or _spmd_partitioner_controlled(
+        points
+    ):
+        return "xla"
+    n = points.shape[1]
+    if fits_vmem(n):
+        return "pallas"
+    # The chunked kernel's column loop is a static unroll — auto caps it
+    # where compile time stays sane (explicit impl="pallas_big" can go
+    # further; see knn_batch_pallas_big).
+    return "pallas_big" if fits_big_kernel(n) else "xla"
 
 
 def _spmd_partitioner_controlled(points: Array) -> bool:
@@ -178,10 +186,15 @@ def knn_batch(
     ``impl``: ``"xla"`` — ``vmap`` of :func:`knn` (works everywhere);
     ``"pallas"`` — the fused TPU kernel (ops/knn_pallas.py), which never
     materializes the ``(M, N, N)`` distance tensor in HBM;
-    ``"pallas_interpret"`` — the same kernel in interpret mode (CPU tests);
-    ``"auto"`` — pallas on TPU backends when the kernel's intermediates fit
-    VMEM (N <= 640: 641 pads to 768 lanes and the ~6 live (1, 768, 768) f32
-    intermediates exceed the 12 MiB budget) AND the batch is not under
+    ``"pallas_big"`` — the chunked-streaming kernel for swarms past the
+    fused kernel's VMEM cliff (N > 640; O(block) VMEM regardless of N);
+    ``"pallas_interpret"`` / ``"pallas_big_interpret"`` — the same kernels
+    in interpret mode (CPU tests);
+    ``"auto"`` — on TPU, pallas when the kernel's intermediates fit VMEM
+    (N <= 640: 641 pads to 768 lanes and the ~6 live (1, 768, 768) f32
+    intermediates exceed the 12 MiB budget), pallas_big for
+    640 < N <= 16384 (the static chunk unroll keeps compile time bounded;
+    ``fits_big_kernel``), xla beyond — provided the batch is not under
     SPMD-partitioner control
     (a ``pallas_call`` is a Mosaic custom call the partitioner cannot split,
     so a dp-sharded batch traced under plain ``jit`` falls back to xla;
@@ -198,6 +211,14 @@ def knn_batch(
 
         return knn_batch_pallas(
             points, k, valid, interpret=(impl == "pallas_interpret")
+        )
+    if impl in ("pallas_big", "pallas_big_interpret"):
+        from marl_distributedformation_tpu.ops.knn_pallas import (
+            knn_batch_pallas_big,
+        )
+
+        return knn_batch_pallas_big(
+            points, k, valid, interpret=(impl == "pallas_big_interpret")
         )
     assert impl == "xla", f"unknown knn impl {impl!r}"
     if valid is None:

@@ -50,10 +50,55 @@ def padded_n(n: int) -> int:
 
 
 def fits_vmem(n: int) -> bool:
-    """True when the kernel's intermediates fit the VMEM budget even at the
-    minimum block_m=1 — the dispatch condition for ``impl="auto"``."""
+    """True when the fused kernel's intermediates fit the VMEM budget even
+    at the minimum block_m=1 — the dispatch condition for ``impl="auto"``."""
     np_ = padded_n(n)
     return 6 * 4 * np_ * np_ <= _VMEM_BUDGET
+
+
+# Auto-dispatch ceiling for the chunked kernel: its resident cost is three
+# full (block_m, n_pad) f32 position/validity planes plus the (R, C) tile
+# intermediates, and the column loop is a STATIC unroll of n_pad/chunk_c
+# chunks (compile time grows O(N * k^2 / chunk_c)). 16384 points keeps the
+# planes at ~200 KB and the unroll at 32 chunks; beyond that "auto" falls
+# back to XLA (explicit impl="pallas_big" still allowed for larger N —
+# VMEM holds to ~1M points, but expect long compiles).
+_BIG_KERNEL_AUTO_MAX_N = 16384
+
+
+def fits_big_kernel(n: int) -> bool:
+    return n <= _BIG_KERNEL_AUTO_MAX_N
+
+
+def _pad_planes(points: Array, valid, m_pad: int, n_pad: int):
+    """Struct-of-arrays prologue shared by both kernels: f32 cast, x/y
+    plane split, validity plane, zero-padding to the padded grid shape."""
+    m, n = points.shape[:2]
+    pts = points.astype(jnp.float32)
+    x = jnp.pad(pts[..., 0], ((0, m_pad - m), (0, n_pad - n)))
+    y = jnp.pad(pts[..., 1], ((0, m_pad - m), (0, n_pad - n)))
+    if valid is None:
+        vm = jnp.ones((m, n), jnp.float32)
+    else:
+        vm = valid.astype(jnp.float32)
+    vm = jnp.pad(vm, ((0, m_pad - m), (0, n_pad - n)))
+    return x, y, vm
+
+
+def _unpack_outputs(idx, offx, offy, dist, m: int, n: int):
+    """Epilogue shared by both kernels: strip padding, move k to the
+    trailing axis, re-assemble (M, N, k, 2) offsets — the public
+    ``ops.knn.knn`` layout."""
+    idx = jnp.swapaxes(idx[:m, :, :n], 1, 2)  # (M, N, k)
+    offsets = jnp.stack(
+        [
+            jnp.swapaxes(offx[:m, :, :n], 1, 2),
+            jnp.swapaxes(offy[:m, :, :n], 1, 2),
+        ],
+        axis=-1,
+    )
+    dists = jnp.swapaxes(dist[:m, :, :n], 1, 2)
+    return idx, offsets, dists
 
 
 def _knn_kernel(k, x_ref, y_ref, vmask_ref, idx_ref, offx_ref, offy_ref,
@@ -92,6 +137,164 @@ def _knn_kernel(k, x_ref, y_ref, vmask_ref, idx_ref, offx_ref, offy_ref,
             real, jnp.sqrt(jnp.maximum(best, 0.0)), 0.0
         )
         d2 = jnp.where(onehot, _SELF_MASK, d2)  # exclude from later passes
+
+
+def _knn_kernel_chunked(
+    k, chunk_c, x_rows_ref, y_rows_ref, x_cols_ref, y_cols_ref, vm_ref,
+    idx_ref, offx_ref, offy_ref, dist_ref,
+):
+    """Grid step for the big-N kernel: k-NN for a ``(B, R)`` block of query
+    rows against the full ``(B, Np)`` point set, streamed in ``chunk_c``-
+    column chunks so VMEM holds ``(B, R, C)`` — never ``(B, Np, Np)``.
+
+    Running best-k state is a bubble-insertion sorted list (k small): each
+    chunk contributes its k best via argmin passes, and every candidate is
+    inserted with a strict ``<`` compare — equal distances never displace
+    an earlier (lower-column) candidate, which reproduces ``lax.top_k``'s
+    stable tie-breaking, so results are bit-identical to the XLA path.
+    """
+    b, r_block = x_rows_ref.shape
+    n_pad = x_cols_ref.shape[1]
+    xr = x_rows_ref[:]  # (B, R)
+    yr = y_rows_ref[:]
+    rb = pl.program_id(1)
+    row_gids = rb * r_block + jax.lax.broadcasted_iota(
+        jnp.int32, (b, r_block), 1
+    )
+
+    zero_f = jnp.zeros((b, r_block), jnp.float32)
+    best_d = [zero_f + _SELF_MASK for _ in range(k)]
+    best_i = [jnp.zeros((b, r_block), jnp.int32) for _ in range(k)]
+    best_x = [zero_f for _ in range(k)]
+    best_y = [zero_f for _ in range(k)]
+
+    for c in range(n_pad // chunk_c):  # static unroll over column chunks
+        sl = slice(c * chunk_c, (c + 1) * chunk_c)
+        xc = x_cols_ref[:, sl]  # (B, C)
+        yc = y_cols_ref[:, sl]
+        vmc = vm_ref[:, sl]
+        d2 = (xr[:, :, None] - xc[:, None, :]) ** 2 + (
+            yr[:, :, None] - yc[:, None, :]
+        ) ** 2  # (B, R, C)
+        local_cols = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 2)
+        global_cols = local_cols + c * chunk_c
+        blocked = (global_cols == row_gids[:, :, None]) | (
+            vmc[:, None, :] < 0.5
+        )
+        d2 = jnp.where(blocked, _SELF_MASK, d2)
+        xcb = jnp.broadcast_to(xc[:, None, :], d2.shape)
+        ycb = jnp.broadcast_to(yc[:, None, :], d2.shape)
+        for _ in range(k):  # chunk's k best, ascending
+            cd = jnp.min(d2, axis=2)
+            am = jnp.argmin(d2, axis=2).astype(jnp.int32)
+            onehot = local_cols == am[:, :, None]
+            ci = c * chunk_c + am
+            cx = jnp.sum(jnp.where(onehot, xcb, 0.0), axis=2)
+            cy = jnp.sum(jnp.where(onehot, ycb, 0.0), axis=2)
+            d2 = jnp.where(onehot, _SELF_MASK, d2)
+            for j in range(k):  # bubble-insert into the sorted running k
+                # Lexicographic (distance, column) compare: a strict '<'
+                # alone would let a displaced lower-column element get
+                # stuck behind an equal-distance one, reordering ties vs
+                # lax.top_k's stable lower-index preference.
+                take = (cd < best_d[j]) | (
+                    (cd == best_d[j]) & (ci < best_i[j])
+                )
+                best_d[j], cd = (
+                    jnp.where(take, cd, best_d[j]),
+                    jnp.where(take, best_d[j], cd),
+                )
+                best_i[j], ci = (
+                    jnp.where(take, ci, best_i[j]),
+                    jnp.where(take, best_i[j], ci),
+                )
+                best_x[j], cx = (
+                    jnp.where(take, cx, best_x[j]),
+                    jnp.where(take, best_x[j], cx),
+                )
+                best_y[j], cy = (
+                    jnp.where(take, cy, best_y[j]),
+                    jnp.where(take, best_y[j], cy),
+                )
+
+    for j in range(k):
+        real = best_d[j] < 0.5 * _SELF_MASK
+        idx_ref[:, j, :] = jnp.where(real, best_i[j], row_gids)
+        offx_ref[:, j, :] = jnp.where(real, best_x[j] - xr, 0.0)
+        offy_ref[:, j, :] = jnp.where(real, best_y[j] - yr, 0.0)
+        dist_ref[:, j, :] = jnp.where(
+            real, jnp.sqrt(jnp.maximum(best_d[j], 0.0)), 0.0
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "block_r", "chunk_c", "block_m", "interpret"),
+)
+def knn_batch_pallas_big(
+    points: Array,
+    k: int,
+    valid: Optional[Array] = None,
+    block_r: int = 256,
+    chunk_c: int = 512,
+    block_m: int = 1,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Batched k-NN for swarms past the fused kernel's VMEM cliff
+    (``fits_vmem`` fails for N > 640): streams the distance matrix in
+    ``(block_r, chunk_c)`` tiles with a running top-k. The ``(M, N, N)``
+    tensor never exists anywhere — not in HBM either, unlike the XLA
+    fallback. VMEM holds the tile intermediates plus three full
+    ``(block_m, n_pad)`` position/validity planes (8 B/point — fine to
+    ~1M points), and the chunk loop is a static unroll of
+    ``n_pad/chunk_c`` iterations, so compile time grows with N;
+    ``impl="auto"`` caps this path at N <= 16384 (``fits_big_kernel``).
+    Output layout and selection semantics are identical to
+    ``knn_batch_pallas`` / ``ops.knn.knn`` (ties break toward the lower
+    index).
+
+    ``block_r``/``chunk_c`` must be lane-aligned (multiples of 128); N pads
+    to their lcm. Defaults stream ~3 MB of VMEM intermediates per program.
+    """
+    m, n, d = points.shape
+    assert d == 2, f"knn_batch_pallas_big is 2-D only, got d={d}"
+    assert k < n, f"knn needs k < N (k={k}, N={n})"
+    assert block_r % 128 == 0 and chunk_c % 128 == 0, (
+        f"block_r/chunk_c must be multiples of 128, got {block_r}/{chunk_c}"
+    )
+    import math
+
+    step = math.lcm(block_r, chunk_c)
+    n_pad = ((n + step - 1) // step) * step
+    m_pad = ((m + block_m - 1) // block_m) * block_m
+    x, y, vm = _pad_planes(points, valid, m_pad, n_pad)
+
+    rows_plane = pl.BlockSpec(
+        (block_m, block_r), lambda i, r: (i, r), memory_space=pltpu.VMEM
+    )
+    cols_plane = pl.BlockSpec(
+        (block_m, n_pad), lambda i, r: (i, 0), memory_space=pltpu.VMEM
+    )
+    out_plane = pl.BlockSpec(
+        (block_m, k, block_r),
+        lambda i, r: (i, 0, r),
+        memory_space=pltpu.VMEM,
+    )
+    out_f32 = jax.ShapeDtypeStruct((m_pad, k, n_pad), jnp.float32)
+    idx, offx, offy, dist = pl.pallas_call(
+        functools.partial(_knn_kernel_chunked, k, chunk_c),
+        grid=(m_pad // block_m, n_pad // block_r),
+        in_specs=[rows_plane, rows_plane, cols_plane, cols_plane, cols_plane],
+        out_specs=[out_plane] * 4,
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, k, n_pad), jnp.int32),
+            out_f32,
+            out_f32,
+            out_f32,
+        ],
+        interpret=interpret,
+    )(x, y, x, y, vm)
+    return _unpack_outputs(idx, offx, offy, dist, m, n)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "block_m", "interpret"))
@@ -135,15 +338,7 @@ def knn_batch_pallas(
         # under the VMEM budget.
         block_m = max(1, min(8, _VMEM_BUDGET // (6 * 4) // (n_pad * n_pad)))
     m_pad = ((m + block_m - 1) // block_m) * block_m
-
-    pts = points.astype(jnp.float32)
-    x = jnp.pad(pts[..., 0], ((0, m_pad - m), (0, n_pad - n)))
-    y = jnp.pad(pts[..., 1], ((0, m_pad - m), (0, n_pad - n)))
-    if valid is None:
-        vm = jnp.ones((m, n), jnp.float32)
-    else:
-        vm = valid.astype(jnp.float32)
-    vm = jnp.pad(vm, ((0, m_pad - m), (0, n_pad - n)))
+    x, y, vm = _pad_planes(points, valid, m_pad, n_pad)
 
     plane = pl.BlockSpec(
         (block_m, n_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
@@ -165,14 +360,4 @@ def knn_batch_pallas(
         ],
         interpret=interpret,
     )(x, y, vm)
-
-    idx = jnp.swapaxes(idx[:m, :, :n], 1, 2)  # (M, N, k)
-    offsets = jnp.stack(
-        [
-            jnp.swapaxes(offx[:m, :, :n], 1, 2),
-            jnp.swapaxes(offy[:m, :, :n], 1, 2),
-        ],
-        axis=-1,
-    )
-    dists = jnp.swapaxes(dist[:m, :, :n], 1, 2)
-    return idx, offsets, dists
+    return _unpack_outputs(idx, offx, offy, dist, m, n)

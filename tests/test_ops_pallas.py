@@ -183,8 +183,12 @@ def test_auto_dispatch_consults_spmd_guard(monkeypatch):
         lambda p: seen.append(knn_mod._resolve_auto_impl(p)) or p
     )(pts_dp)
     assert seen[-1] == "xla"
-    big = jnp.zeros((4, 4096, 2))  # over the VMEM budget at block_m=1
-    assert knn_mod._resolve_auto_impl(big) == "xla"
+    # Over the fused kernel's VMEM budget -> the chunked streaming kernel
+    # (round 3); the SPMD guard still applies to it.
+    big = jnp.zeros((16, 4096, 2))
+    assert knn_mod._resolve_auto_impl(big) == "pallas_big"
+    big_dp = jax.device_put(big, NamedSharding(mesh, P("dp")))
+    assert knn_mod._resolve_auto_impl(big_dp) == "xla"
 
 
 def test_xla_knn_precision():
@@ -217,3 +221,124 @@ def test_xla_knn_precision():
     np.testing.assert_allclose(
         d2[off_diag], ref[off_diag], rtol=1e-5, atol=1e-2
     )
+
+
+class TestChunkedBigKernel:
+    """knn_batch_pallas_big: the streaming kernel for N past the fused
+    kernel's VMEM cliff. Interpret mode with small tiles exercises the
+    multi-chunk / multi-row-block merge paths on CPU."""
+
+    def _run(self, m, n, k, block_r=128, chunk_c=128, valid=None, seed=0):
+        from marl_distributedformation_tpu.ops.knn_pallas import (
+            knn_batch_pallas_big,
+        )
+
+        pts = jnp.asarray(
+            np.random.default_rng(seed).uniform(0, 400, (m, n, 2)),
+            jnp.float32,
+        )
+        got = knn_batch_pallas_big(
+            pts, k, valid, block_r=block_r, chunk_c=chunk_c, interpret=True
+        )
+        want = knn_batch(pts, k, valid, impl="xla")
+        return got, want
+
+    @pytest.mark.parametrize(
+        "m,n,k,block_r,chunk_c",
+        [
+            (3, 300, 4, 128, 128),   # 3 chunks, 3 row blocks, ragged N
+            (2, 700, 4, 128, 256),   # past the fused kernel's cliff
+            (1, 129, 3, 128, 128),   # barely spills into chunk 2
+            (4, 256, 5, 128, 128),   # k > 4
+        ],
+    )
+    def test_matches_xla(self, m, n, k, block_r, chunk_c):
+        (gi, go, gd), (wi, wo, wd) = self._run(
+            m, n, k, block_r=block_r, chunk_c=chunk_c
+        )
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(wi))
+        np.testing.assert_allclose(
+            np.asarray(gd), np.asarray(wd), rtol=1e-6, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(go), np.asarray(wo), rtol=1e-6, atol=1e-6
+        )
+
+    def test_valid_mask_and_self_loops(self):
+        """Invalid points are never selected; short rows degrade to
+        self-loops exactly like ops.knn.knn's valid path."""
+        rng = np.random.default_rng(5)
+        valid = jnp.asarray(rng.random((3, 300)) > 0.5)
+        (gi, go, gd), (wi, wo, wd) = self._run(3, 300, 4, valid=valid)
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(wi))
+        np.testing.assert_allclose(
+            np.asarray(gd), np.asarray(wd), rtol=1e-6, atol=1e-6
+        )
+
+    def test_tie_breaking_matches_top_k(self):
+        """Duplicate coordinates force distance ties; selection must match
+        lax.top_k's stable lower-index preference bit-for-bit."""
+        from marl_distributedformation_tpu.ops.knn_pallas import (
+            knn_batch_pallas_big,
+        )
+
+        base = np.random.default_rng(9).uniform(0, 400, (2, 40, 2))
+        pts = np.tile(base, (1, 8, 1))  # every point duplicated 8x -> 320
+        pts = jnp.asarray(pts, jnp.float32)
+        gi, _, gd = knn_batch_pallas_big(
+            pts, 4, block_r=128, chunk_c=128, interpret=True
+        )
+        wi, _, wd = knn_batch(pts, 4, impl="xla")
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(wi))
+        np.testing.assert_allclose(
+            np.asarray(gd), np.asarray(wd), rtol=1e-6, atol=1e-6
+        )
+
+    def test_auto_dispatch_selects_big_kernel(self, monkeypatch):
+        import importlib
+
+        knn_mod = importlib.import_module(
+            "marl_distributedformation_tpu.ops.knn"
+        )
+        monkeypatch.setattr(knn_mod.jax, "default_backend", lambda: "tpu")
+        assert knn_mod._resolve_auto_impl(jnp.zeros((4, 100, 2))) == "pallas"
+        assert (
+            knn_mod._resolve_auto_impl(jnp.zeros((4, 641, 2)))
+            == "pallas_big"
+        )
+        assert (
+            knn_mod._resolve_auto_impl(jnp.zeros((4, 4096, 2)))
+            == "pallas_big"
+        )
+        # Past the compile-time cap (static chunk unroll), auto falls back.
+        assert (
+            knn_mod._resolve_auto_impl(jnp.zeros((1, 20000, 2))) == "xla"
+        )
+
+
+    def test_displaced_tie_keeps_top_k_order(self):
+        """Regression for the bubble-insert tie bug: a best list holding
+        two equal-distance neighbors (lower column first) must keep that
+        order when a CLOSER candidate from a later chunk displaces the
+        list — a strict '<' insert would trap the displaced lower-column
+        element behind its equal."""
+        from marl_distributedformation_tpu.ops.knn_pallas import (
+            knn_batch_pallas_big,
+        )
+
+        n = 300
+        pts = np.full((1, n, 2), 1e4, np.float32)
+        pts[0, 0] = (0.0, 0.0)       # query
+        pts[0, 5] = (10.0, 0.0)      # tie A (dist 10), chunk 0
+        pts[0, 9] = (0.0, 10.0)      # tie B (dist 10), chunk 0
+        pts[0, 200] = (1.0, 0.0)     # closer, chunk 1 -> displaces
+        pts = jnp.asarray(pts)
+        gi, _, gd = knn_batch_pallas_big(
+            pts, 3, block_r=128, chunk_c=128, interpret=True
+        )
+        wi, _, wd = knn_batch(pts, 3, impl="xla")
+        assert wi[0, 0].tolist() == [200, 5, 9]  # top_k stable order
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(wi))
+        np.testing.assert_allclose(
+            np.asarray(gd), np.asarray(wd), rtol=1e-6, atol=1e-6
+        )

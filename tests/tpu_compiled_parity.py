@@ -75,6 +75,34 @@ def run_parity(m: int = 4096, n: int = 100, k: int = 4) -> str:
     )
 
 
+def run_parity_big(m: int = 256, n: int = 1024, k: int = 4) -> str:
+    """Compiled chunked-streaming kernel (ops/knn_pallas.py
+    knn_batch_pallas_big — the path for swarms past the fused kernel's
+    N <= 640 VMEM cliff) vs the XLA search, on hardware."""
+    import jax
+    import numpy as np
+
+    from marl_distributedformation_tpu.ops import knn_batch
+    from marl_distributedformation_tpu.ops.knn_pallas import (
+        knn_batch_pallas_big,
+    )
+
+    pts = jax.random.uniform(jax.random.PRNGKey(1), (m, n, 2)) * 400.0
+    idx_b, off_b, d_b = jax.block_until_ready(knn_batch_pallas_big(pts, k))
+    idx_x, off_x, d_x = knn_batch(pts, k, impl="xla")
+    np.testing.assert_array_equal(np.asarray(idx_b), np.asarray(idx_x))
+    np.testing.assert_allclose(
+        np.asarray(d_b), np.asarray(d_x), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(off_b), np.asarray(off_x), rtol=1e-4, atol=1e-4
+    )
+    return (
+        f"compiled pallas_big == xla on {jax.devices()[0].device_kind} "
+        f"(M={m}, N={n}, k={k})"
+    )
+
+
 def main() -> None:
     import jax
 
@@ -85,6 +113,12 @@ def main() -> None:
         msg = run_parity()
     except AssertionError as e:
         print(f"PARITY_FAIL: {e}", flush=True)
+        sys.exit(1)
+    print(f"PARITY_OK: {msg}", flush=True)
+    try:
+        msg = run_parity_big()
+    except AssertionError as e:
+        print(f"PARITY_FAIL(big): {e}", flush=True)
         sys.exit(1)
     print(f"PARITY_OK: {msg}", flush=True)
 
