@@ -16,6 +16,9 @@ Measures, inside one process and one JSON line:
   for, not just env stepping.
 - ``knn_env_steps_per_sec``: the large-swarm variant (N=100 agents, k-NN
   observation graph, BASELINE.json config 4).
+- ``knn_big_env_steps_per_sec``: the N=1024 swarm past the fused kernel's
+  VMEM cliff (chunked-streaming kernel on TPU, XLA elsewhere; the
+  ``knn_big_impl`` field records which ran).
 
 Hardened against the flaky axon tunnel (round-1 failure mode: the first
 device op hung for minutes and the round recorded nothing):
@@ -30,8 +33,9 @@ device op hung for minutes and the round recorded nothing):
   field.
 
 Env-var knobs: BENCH_M, BENCH_N, BENCH_CHUNK, BENCH_TRAIN_M, BENCH_KNN_M,
-BENCH_BUDGET_S, BENCH_PROBE_TIMEOUT_S, BENCH_FORCE_CPU=1,
-BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1.
+BENCH_KNN_BIG_M, BENCH_KNN_BIG_N, BENCH_BUDGET_S, BENCH_PROBE_TIMEOUT_S,
+BENCH_FORCE_CPU=1, BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1,
+BENCH_SKIP_KNN_BIG=1.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -338,58 +342,78 @@ def main() -> None:
             else:
                 notes.append("train phase skipped: deadline")
 
+        def run_knn_phase(prefix: str, n: int, default_m: int, chunk: int):
+            """Time one knn env-stepping variant; record rate + which
+            neighbor-search impl auto-dispatch resolves at this shape.
+            Failures degrade to a note, like every other phase."""
+            try:
+                key = prefix.replace("-", "_")
+                m = _env_int(f"BENCH_{key.upper()}_M", default_m)
+                params = EnvParams(num_agents=n, obs_mode="knn", knn_k=4)
+                rate = _time_env_phase(params, m, chunk, deadline)
+
+                import jax.numpy as jnp
+
+                from marl_distributedformation_tpu.ops.knn import (
+                    _resolve_auto_impl,
+                )
+
+                result[f"{key}_env_steps_per_sec"] = round(rate, 1)
+                result[f"{key}_m"] = m
+                result[f"{key}_n"] = n
+                result[f"{key}_impl"] = _resolve_auto_impl(
+                    jnp.zeros((m, n, 2))
+                )
+                print(
+                    f"[bench] {prefix} (N={n}): {rate:,.0f} "
+                    f"formation-steps/s ({result[f'{key}_impl']})",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                notes.append(f"{prefix} phase failed: {e!r}"[:200])
+
         # Phase 3 — large-swarm knn variant (BASELINE.json config 4).
         if os.environ.get("BENCH_SKIP_KNN") != "1":
             if time.time() < deadline - 30:
+                run_knn_phase(
+                    "knn", 100, 4096 if on_accel else 256,
+                    max(CHUNK // 8, 16),
+                )
+                # Provenance (VERDICT.md r2 weak #4): the committed
+                # hardware-parity status of the pallas/xla pair
+                # (docs/acceptance/tpu_parity.txt, written by
+                # tests/tpu_compiled_parity.py on the chip).
+                parity_file = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "docs", "acceptance", "tpu_parity.txt",
+                )
                 try:
-                    knn_m = _env_int(
-                        "BENCH_KNN_M", 4096 if on_accel else 256
+                    with open(parity_file) as pf:
+                        status = [
+                            ln.strip() for ln in pf
+                            if ln.startswith("PARITY")
+                        ]
+                    result["knn_device_parity"] = (
+                        status[-1][:160] if status else "artifact empty"
                     )
-                    knn_params = EnvParams(
-                        num_agents=100, obs_mode="knn", knn_k=4
-                    )
-                    k_rate = _time_env_phase(
-                        knn_params, knn_m, max(CHUNK // 8, 16), deadline
-                    )
-                    result["knn_env_steps_per_sec"] = round(k_rate, 1)
-                    result["knn_m"] = knn_m
-                    # Provenance (VERDICT.md r2 weak #4): which neighbor
-                    # search ran, and the committed hardware-parity status
-                    # of the pallas/xla pair (docs/acceptance/tpu_parity.txt,
-                    # written by tests/tpu_compiled_parity.py on the chip).
-                    import jax.numpy as jnp
-
-                    from marl_distributedformation_tpu.ops.knn import (
-                        _resolve_auto_impl,
-                    )
-
-                    result["knn_impl"] = _resolve_auto_impl(
-                        jnp.zeros((knn_m, 100, 2))
-                    )
-                    parity_file = os.path.join(
-                        os.path.dirname(os.path.abspath(__file__)),
-                        "docs", "acceptance", "tpu_parity.txt",
-                    )
-                    try:
-                        with open(parity_file) as pf:
-                            status = [
-                                ln.strip() for ln in pf
-                                if ln.startswith("PARITY")
-                            ]
-                        result["knn_device_parity"] = (
-                            status[-1][:160] if status else "artifact empty"
-                        )
-                    except OSError:
-                        result["knn_device_parity"] = "no committed artifact"
-                    print(
-                        f"[bench] knn (N=100): {k_rate:,.0f} "
-                        "formation-steps/s",
-                        file=sys.stderr,
-                    )
-                except Exception as e:  # noqa: BLE001
-                    notes.append(f"knn phase failed: {e!r}"[:200])
+                except OSError:
+                    result["knn_device_parity"] = "no committed artifact"
             else:
                 notes.append("knn phase skipped: deadline")
+
+        # Phase 4 — swarm past the fused kernel's VMEM cliff (N=1024):
+        # the chunked-streaming kernel (ops/knn_pallas.py
+        # knn_batch_pallas_big) on TPU, XLA elsewhere.
+        if os.environ.get("BENCH_SKIP_KNN_BIG") != "1":
+            if time.time() < deadline - 30:
+                run_knn_phase(
+                    "knn-big",
+                    _env_int("BENCH_KNN_BIG_N", 1024),
+                    512 if on_accel else 32,
+                    max(CHUNK // 32, 8),
+                )
+            else:
+                notes.append("knn-big phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:
