@@ -16,7 +16,7 @@ scripted potential-field baseline (env/baseline.py, the reference's
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp

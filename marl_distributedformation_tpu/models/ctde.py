@@ -30,7 +30,6 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
 from flax import linen as nn
 
 from marl_distributedformation_tpu.models.common import (

@@ -16,8 +16,6 @@ from __future__ import annotations
 
 import sys
 
-import numpy as np
-
 
 def main(argv=None) -> None:
     from marl_distributedformation_tpu.utils import (
