@@ -321,3 +321,26 @@ def test_knn_ring_step_matches_unsharded(dp, sp):
             np.asarray(state_ring.agents), np.asarray(state_ref.agents),
             rtol=1e-5, atol=1e-5,
         )
+
+
+@pytest.mark.slow
+def test_gnn_trains_on_sp_mesh(tmp_path):
+    """A formation-level model (GNN) composes with agent-axis sharding:
+    the env step runs the sharded all-gather + local-query search, and the
+    SPMD partitioner re-gathers the agent axis where the per-formation
+    forward needs it. One full iteration, finite loss."""
+    from marl_distributedformation_tpu.models import GNNActorCritic
+
+    params = EnvParams(num_agents=8, obs_mode="knn", knn_k=2, knn_impl="xla")
+    trainer = Trainer(
+        params,
+        ppo=PPOConfig(n_steps=2, batch_size=64, n_epochs=1),
+        config=TrainConfig(
+            num_formations=4, checkpoint=False,
+            log_dir=str(tmp_path / "logs"),
+        ),
+        model=GNNActorCritic(k=2, act_dim=2, goal_in_obs=params.goal_in_obs),
+        shard_fn=make_shard_fn({"dp": 2, "sp": 2}),
+    )
+    assert trainer._env_step_fn is not None
+    assert np.isfinite(trainer.run_iteration()["loss"])
