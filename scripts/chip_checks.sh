@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Run every hardware-dependent validation in one go and refresh the
+# committed artifacts. Run from the repo root when the TPU tunnel is up
+# (probe first: the tunnel drops for hours — bench.py's subprocess probe
+# pattern; a bare jax.devices() can hang forever).
+#
+#   bash scripts/chip_checks.sh
+#
+# Artifacts refreshed:
+#   docs/acceptance/tpu_parity.txt   (k-NN parity, BOTH kernels, f64 anchor)
+#   docs/profiling.md table input    (stdout of tpu_profile_breakdown)
+#   /tmp/bench_tpu.json              (full bench line — inspect, then
+#                                     mirror into docs/acceptance/ if it
+#                                     supersedes tpu_bench_r3.md)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+python - <<'EOF'
+import subprocess, sys
+out = subprocess.run(
+    [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+    capture_output=True, text=True, timeout=90,
+)
+platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+print("platform:", platform or out.stderr[-200:])
+sys.exit(0 if platform and platform != "cpu" else 1)
+EOF
+
+echo "== k-NN hardware parity (fused + chunked kernels, f64 anchor) =="
+python tests/tpu_compiled_parity.py | tee /tmp/parity_out.txt
+{
+  echo "# TPU hardware k-NN parity artifact"
+  echo "# command: python tests/tpu_compiled_parity.py"
+  echo "# date: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  python -c "import jax; print('# device:', jax.devices()[0].device_kind, '| backend:', jax.default_backend())" | grep '^#'
+  grep PARITY /tmp/parity_out.txt
+} > docs/acceptance/tpu_parity.txt
+cat docs/acceptance/tpu_parity.txt
+
+echo "== training profile breakdown (parity vs preset=tpu) =="
+python scripts/tpu_profile_breakdown.py 4096
+
+echo "== full bench =="
+python bench.py | tail -1 > /tmp/bench_tpu.json
+cat /tmp/bench_tpu.json
+
+echo "== done — review artifacts, then commit =="
