@@ -147,8 +147,9 @@ def compute_obs(
     """
     if params.obs_mode == "knn":
         assert pos_neighbors is None, (
-            "knn obs is incompatible with the ring halo-exchange path; "
-            "shard formations ('dp') only for knn swarms"
+            "knn obs does not take precomputed ring neighbors; the "
+            "agent-axis-sharded knn path goes through "
+            "compute_obs_knn_sharded (parallel/ring.py), not this argument"
         )
         return compute_obs_knn(agents, goal, params)
     wh = jnp.array([params.width, params.height], dtype=jnp.float32)

@@ -1,4 +1,6 @@
-"""TPU compute ops: k-NN neighbor search (XLA and fused Pallas paths)."""
+"""TPU compute ops: k-NN neighbor search (XLA path; fused Pallas kernel
+for N <= 640; chunked-streaming Pallas kernel beyond; local-query variant
+for agent-axis sharding)."""
 
 from marl_distributedformation_tpu.ops.knn import (  # noqa: F401
     knn,
