@@ -11,9 +11,10 @@ and the neighbor gather via one-hot select, with only the ``(M, k, N)``
 results ever touching HBM.
 
 Layout notes (guide: /opt/skills/guides/pallas_guide.md):
-- positions are fed struct-of-arrays (x and y as separate ``(M, N)``
+- positions are fed struct-of-arrays (x and y as separate ``(M, 1, N)``
   planes) so the lane dimension is the agent axis padded to 128, instead
-  of a 2-wide trailing dimension padded 64x;
+  of a 2-wide trailing dimension padded 64x; the singleton middle axis
+  keeps every block Mosaic-legal at any ``block_m`` (see ``_pad_planes``);
 - outputs are ``(M, k, N)`` (k on the sublane axis) and transposed to the
   public ``(M, N, k)`` layout outside the kernel;
 - the grid runs blocks of ``block_m`` formations per program; ``block_m``
@@ -72,7 +73,18 @@ def fits_big_kernel(n: int) -> bool:
 
 def _pad_planes(points: Array, valid, m_pad: int, n_pad: int):
     """Struct-of-arrays prologue shared by both kernels: f32 cast, x/y
-    plane split, validity plane, zero-padding to the padded grid shape."""
+    plane split, validity plane, zero-padding to the padded grid shape.
+
+    Planes are shaped ``(m_pad, 1, n_pad)`` — NOT ``(m_pad, n_pad)`` — so
+    their block shape ``(block_m, 1, n_pad)`` is always Mosaic-legal: the
+    TPU lowering requires the last two block dims be divisible by (8, 128)
+    or equal the array dims, and a 2-D ``(block_m, n_pad)`` block violates
+    the sublane rule whenever the VMEM budget drives ``block_m`` below 8
+    (fused kernel at N in [384, 640], chunked kernel always). The singleton
+    axis pins the sublane dim to "equal the array dim" for any block_m.
+    Interpret mode never enforces this, so CPU tests can't catch it —
+    tests/tpu_compiled_parity.py exercises the compiled shapes on hardware.
+    """
     m, n = points.shape[:2]
     pts = points.astype(jnp.float32)
     x = jnp.pad(pts[..., 0], ((0, m_pad - m), (0, n_pad - n)))
@@ -82,7 +94,7 @@ def _pad_planes(points: Array, valid, m_pad: int, n_pad: int):
     else:
         vm = valid.astype(jnp.float32)
     vm = jnp.pad(vm, ((0, m_pad - m), (0, n_pad - n)))
-    return x, y, vm
+    return x[:, None, :], y[:, None, :], vm[:, None, :]
 
 
 def _unpack_outputs(idx, offx, offy, dist, m: int, n: int):
@@ -110,9 +122,9 @@ def _knn_kernel(k, x_ref, y_ref, vmask_ref, idx_ref, offx_ref, offy_ref,
     remaining distances at ``_SELF_MASK``) degrade to self-loops
     (idx=i, offset=0, dist=0), mirroring ``ops.knn.knn``'s ``valid`` path.
     """
-    x = x_ref[:]  # (B, Np)
-    y = y_ref[:]
-    vm = vmask_ref[:]
+    x = x_ref[:, 0, :]  # (B, Np); refs carry the Mosaic-layout
+    y = y_ref[:, 0, :]  # singleton axis (_pad_planes)
+    vm = vmask_ref[:, 0, :]
     d2 = (x[:, :, None] - x[:, None, :]) ** 2 + (
         y[:, :, None] - y[:, None, :]
     ) ** 2  # (B, Np, Np)
@@ -153,10 +165,10 @@ def _knn_kernel_chunked(
     an earlier (lower-column) candidate, which reproduces ``lax.top_k``'s
     stable tie-breaking, so results are bit-identical to the XLA path.
     """
-    b, r_block = x_rows_ref.shape
-    n_pad = x_cols_ref.shape[1]
-    xr = x_rows_ref[:]  # (B, R)
-    yr = y_rows_ref[:]
+    b, _, r_block = x_rows_ref.shape  # refs carry the Mosaic-layout
+    n_pad = x_cols_ref.shape[2]  # singleton axis (_pad_planes)
+    xr = x_rows_ref[:, 0, :]  # (B, R)
+    yr = y_rows_ref[:, 0, :]
     rb = pl.program_id(1)
     row_gids = rb * r_block + jax.lax.broadcasted_iota(
         jnp.int32, (b, r_block), 1
@@ -170,9 +182,9 @@ def _knn_kernel_chunked(
 
     for c in range(n_pad // chunk_c):  # static unroll over column chunks
         sl = slice(c * chunk_c, (c + 1) * chunk_c)
-        xc = x_cols_ref[:, sl]  # (B, C)
-        yc = y_cols_ref[:, sl]
-        vmc = vm_ref[:, sl]
+        xc = x_cols_ref[:, 0, sl]  # (B, C)
+        yc = y_cols_ref[:, 0, sl]
+        vmc = vm_ref[:, 0, sl]
         d2 = (xr[:, :, None] - xc[:, None, :]) ** 2 + (
             yr[:, :, None] - yc[:, None, :]
         ) ** 2  # (B, R, C)
@@ -245,7 +257,7 @@ def knn_batch_pallas_big(
     ``(block_r, chunk_c)`` tiles with a running top-k. The ``(M, N, N)``
     tensor never exists anywhere — not in HBM either, unlike the XLA
     fallback. VMEM holds the tile intermediates plus three full
-    ``(block_m, n_pad)`` position/validity planes (8 B/point — fine to
+    ``(block_m, 1, n_pad)`` position/validity planes (8 B/point — fine to
     ~1M points), and the chunk loop is a static unroll of
     ``n_pad/chunk_c`` iterations, so compile time grows with N;
     ``impl="auto"`` caps this path at N <= 16384 (``fits_big_kernel``).
@@ -270,10 +282,10 @@ def knn_batch_pallas_big(
     x, y, vm = _pad_planes(points, valid, m_pad, n_pad)
 
     rows_plane = pl.BlockSpec(
-        (block_m, block_r), lambda i, r: (i, r), memory_space=pltpu.VMEM
+        (block_m, 1, block_r), lambda i, r: (i, 0, r), memory_space=pltpu.VMEM
     )
     cols_plane = pl.BlockSpec(
-        (block_m, n_pad), lambda i, r: (i, 0), memory_space=pltpu.VMEM
+        (block_m, 1, n_pad), lambda i, r: (i, 0, 0), memory_space=pltpu.VMEM
     )
     out_plane = pl.BlockSpec(
         (block_m, k, block_r),
@@ -341,7 +353,7 @@ def knn_batch_pallas(
     x, y, vm = _pad_planes(points, valid, m_pad, n_pad)
 
     plane = pl.BlockSpec(
-        (block_m, n_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
+        (block_m, 1, n_pad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
     )
     out_plane = pl.BlockSpec(
         (block_m, k, n_pad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM

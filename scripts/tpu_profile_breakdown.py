@@ -11,6 +11,12 @@ from __future__ import annotations
 
 import json
 import sys
+from pathlib import Path
+
+# Standalone-invocation bootstrap: `python scripts/tpu_profile_breakdown.py`
+# puts scripts/ (not the repo root) on sys.path, and the package may not be
+# pip-installed on a fresh machine.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def main() -> None:

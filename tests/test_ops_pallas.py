@@ -146,13 +146,17 @@ def test_reset_obs_batch_path():
     "round-1 #5)",
 )
 def test_compiled_pallas_parity_on_tpu():
-    """North-star shape (M=4096, N=100, k=4): the COMPILED kernel must match
-    the XLA path. Interpret mode (the CPU tests above) does not exercise
-    Mosaic lowering; this does. Single source of truth for the assertion:
+    """All three hardware legs: the north-star shape (fused, block_m=8),
+    the mid-N sublane regime (fused, block_m=2 — the Mosaic (8, 128) rule
+    regression gate for the singleton-axis plane layout), and the chunked
+    big-N kernel. Interpret mode (the CPU tests above) does not exercise
+    Mosaic lowering; this does. Single source of truth for the assertions:
     tests/tpu_compiled_parity.py."""
-    from tpu_compiled_parity import run_parity
+    from tpu_compiled_parity import run_parity, run_parity_big, run_parity_mid
 
     run_parity()
+    run_parity_mid()
+    run_parity_big()
 
 
 def test_auto_dispatch_consults_spmd_guard(monkeypatch):

@@ -8,13 +8,36 @@ holds the single copy of the compiled-parity assertion:
 - on hardware, run it directly: ``python tests/tpu_compiled_parity.py``
   (prints one PARITY_OK / PARITY_FAIL line), or run the whole suite with
   ``MDF_TPU_TESTS=1 pytest tests/`` (conftest leaves the real backend on and
-  ``test_ops_pallas.py::test_compiled_pallas_parity_on_tpu`` calls
-  :func:`run_parity`);
+  ``test_ops_pallas.py::test_compiled_pallas_parity_on_tpu`` runs all
+  three legs);
 - bench.py's knn phase also exercises the compiled kernel on TPU
   (``impl="auto"`` selects it inside the jitted scan).
 """
 
 import sys
+from pathlib import Path
+
+# Standalone-invocation bootstrap: `python tests/tpu_compiled_parity.py`
+# puts tests/ (not the repo root) on sys.path, and the package may not be
+# pip-installed on a fresh machine — resolve the repo root explicitly so
+# the documented command works from anywhere.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _assert_matches_xla(pallas_out, xla_out) -> None:
+    """The shared leg assertion: exact index agreement, f32-tolerance
+    distance/offset agreement, pallas vs the XLA search."""
+    import numpy as np
+
+    idx_p, off_p, d_p = pallas_out
+    idx_x, off_x, d_x = xla_out
+    np.testing.assert_array_equal(np.asarray(idx_p), np.asarray(idx_x))
+    np.testing.assert_allclose(
+        np.asarray(d_p), np.asarray(d_x), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(off_p), np.asarray(off_x), rtol=1e-4, atol=1e-4
+    )
 
 
 def run_parity(m: int = 4096, n: int = 100, k: int = 4) -> str:
@@ -35,14 +58,10 @@ def run_parity(m: int = 4096, n: int = 100, k: int = 4) -> str:
     from marl_distributedformation_tpu.ops.knn_pallas import knn_batch_pallas
 
     pts = jax.random.uniform(jax.random.PRNGKey(0), (m, n, 2)) * 400.0
-    idx_p, off_p, d_p = jax.block_until_ready(knn_batch_pallas(pts, k))
-    idx_x, off_x, d_x = knn_batch(pts, k, impl="xla")
-    np.testing.assert_array_equal(np.asarray(idx_p), np.asarray(idx_x))
-    np.testing.assert_allclose(
-        np.asarray(d_p), np.asarray(d_x), rtol=1e-4, atol=1e-4
-    )
-    np.testing.assert_allclose(
-        np.asarray(off_p), np.asarray(off_x), rtol=1e-4, atol=1e-4
+    xla_out = knn_batch(pts, k, impl="xla")
+    idx_x, _, d_x = xla_out
+    _assert_matches_xla(
+        jax.block_until_ready(knn_batch_pallas(pts, k)), xla_out
     )
 
     # Host float64 ground truth (vectorized; ~0.5 GB peak at the default
@@ -75,12 +94,34 @@ def run_parity(m: int = 4096, n: int = 100, k: int = 4) -> str:
     )
 
 
+def run_parity_mid(m: int = 256, n: int = 512, k: int = 4) -> str:
+    """Compiled FUSED kernel at mid N (512 pads to 512 lanes, VMEM drives
+    block_m to 2) vs the XLA search, on hardware. Pins the Mosaic sublane
+    rule for sub-8 block_m blocks: a 2-D ``(block_m, n_pad)`` plane is not
+    lowerable when block_m < 8, which interpret-mode CPU tests never see
+    (the singleton-axis layout in ops/knn_pallas.py:_pad_planes is the
+    fix; this leg is its hardware regression gate)."""
+    import jax
+
+    from marl_distributedformation_tpu.ops import knn_batch
+    from marl_distributedformation_tpu.ops.knn_pallas import knn_batch_pallas
+
+    pts = jax.random.uniform(jax.random.PRNGKey(2), (m, n, 2)) * 400.0
+    _assert_matches_xla(
+        jax.block_until_ready(knn_batch_pallas(pts, k)),
+        knn_batch(pts, k, impl="xla"),
+    )
+    return (
+        f"compiled pallas (block_m=2 sublane regime) == xla on "
+        f"{jax.devices()[0].device_kind} (M={m}, N={n}, k={k})"
+    )
+
+
 def run_parity_big(m: int = 256, n: int = 1024, k: int = 4) -> str:
     """Compiled chunked-streaming kernel (ops/knn_pallas.py
     knn_batch_pallas_big — the path for swarms past the fused kernel's
     N <= 640 VMEM cliff) vs the XLA search, on hardware."""
     import jax
-    import numpy as np
 
     from marl_distributedformation_tpu.ops import knn_batch
     from marl_distributedformation_tpu.ops.knn_pallas import (
@@ -88,14 +129,9 @@ def run_parity_big(m: int = 256, n: int = 1024, k: int = 4) -> str:
     )
 
     pts = jax.random.uniform(jax.random.PRNGKey(1), (m, n, 2)) * 400.0
-    idx_b, off_b, d_b = jax.block_until_ready(knn_batch_pallas_big(pts, k))
-    idx_x, off_x, d_x = knn_batch(pts, k, impl="xla")
-    np.testing.assert_array_equal(np.asarray(idx_b), np.asarray(idx_x))
-    np.testing.assert_allclose(
-        np.asarray(d_b), np.asarray(d_x), rtol=1e-4, atol=1e-4
-    )
-    np.testing.assert_allclose(
-        np.asarray(off_b), np.asarray(off_x), rtol=1e-4, atol=1e-4
+    _assert_matches_xla(
+        jax.block_until_ready(knn_batch_pallas_big(pts, k)),
+        knn_batch(pts, k, impl="xla"),
     )
     return (
         f"compiled pallas_big == xla on {jax.devices()[0].device_kind} "
@@ -113,6 +149,12 @@ def main() -> None:
         msg = run_parity()
     except AssertionError as e:
         print(f"PARITY_FAIL: {e}", flush=True)
+        sys.exit(1)
+    print(f"PARITY_OK: {msg}", flush=True)
+    try:
+        msg = run_parity_mid()
+    except AssertionError as e:
+        print(f"PARITY_FAIL(mid): {e}", flush=True)
         sys.exit(1)
     print(f"PARITY_OK: {msg}", flush=True)
     try:
