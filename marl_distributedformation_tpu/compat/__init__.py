@@ -5,3 +5,7 @@ from marl_distributedformation_tpu.compat.policy import (  # noqa: F401
     LoadedPolicy,
     load_checkpoint_raw,
 )
+from marl_distributedformation_tpu.compat.sb3_import import (  # noqa: F401
+    import_sb3_checkpoint,
+    sb3_state_dict_to_flax,
+)

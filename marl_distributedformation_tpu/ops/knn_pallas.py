@@ -257,8 +257,10 @@ def knn_batch_pallas_big(
     ``(block_r, chunk_c)`` tiles with a running top-k. The ``(M, N, N)``
     tensor never exists anywhere — not in HBM either, unlike the XLA
     fallback. VMEM holds the tile intermediates plus three full
-    ``(block_m, 1, n_pad)`` position/validity planes (8 B/point — fine to
-    ~1M points), and the chunk loop is a static unroll of
+    ``(block_m, 1, n_pad)`` position/validity planes (~96 B/point: Mosaic
+    pads the singleton sublane axis to 8, so each f32 plane costs
+    32 B/point — fine to ~10^5 points), and the chunk loop is a static
+    unroll of
     ``n_pad/chunk_c`` iterations, so compile time grows with N;
     ``impl="auto"`` caps this path at N <= 16384 (``fits_big_kernel``).
     Output layout and selection semantics are identical to

@@ -495,14 +495,24 @@ class Trainer:
         if path is None:
             return
         # Partial restore: a multi-host-written (learner-only) checkpoint
-        # resumes fine single-host — env state just starts fresh.
+        # resumes fine single-host — env state just starts fresh. A
+        # converted SB3 checkpoint (compat/sb3_import.py) carries params
+        # only; missing learner pieces (opt_state, key) keep their fresh
+        # values — a warm-started fine-tune re-estimates Adam moments
+        # within a few iterations.
         restored = restore_checkpoint_partial(
             path, self._checkpoint_target()
         )
         self.train_state = self.train_state.replace(
-            params=restored["params"], opt_state=restored["opt_state"]
+            params=restored["params"],
+            opt_state=restored.get("opt_state", self.train_state.opt_state),
         )
-        self.key = restored["key"]
+        if "key" in restored:
+            self.key = restored["key"]
+        # num_timesteps stays REQUIRED: every writer (trainer save,
+        # sb3_import) records it, so its absence means a truncated or
+        # foreign file — silently restarting the counter at 0 would write
+        # low-step checkpoints beside high-step ones and reset schedules.
         self.num_timesteps = int(restored["num_timesteps"])
         if "env_state" in restored:
             self.env_state = restored["env_state"]

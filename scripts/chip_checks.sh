@@ -29,13 +29,17 @@ EOF
 
 echo "== k-NN hardware parity (fused + chunked kernels, f64 anchor) =="
 python tests/tpu_compiled_parity.py | tee /tmp/parity_out.txt
+# Build the artifact in a temp file and rename atomically: a tunnel drop
+# mid-pipeline once truncated the committed artifact to its header.
 {
   echo "# TPU hardware k-NN parity artifact"
   echo "# command: python tests/tpu_compiled_parity.py"
   echo "# date: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
   python -c "import jax; print('# device:', jax.devices()[0].device_kind, '| backend:', jax.default_backend())" | grep '^#'
   grep PARITY /tmp/parity_out.txt
-} > docs/acceptance/tpu_parity.txt
+} > /tmp/tpu_parity.txt.tmp
+grep -q PARITY /tmp/tpu_parity.txt.tmp  # refuse to publish a header-only file
+mv /tmp/tpu_parity.txt.tmp docs/acceptance/tpu_parity.txt
 cat docs/acceptance/tpu_parity.txt
 
 echo "== training profile breakdown (parity vs preset=tpu) =="

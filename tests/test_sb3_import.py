@@ -1,0 +1,201 @@
+"""SB3 checkpoint importer (compat/sb3_import.py).
+
+The fixture builds a real ``PPO.save``-shaped zip — ``data`` JSON +
+``policy.pth`` holding a torch ``state_dict`` with SB3 ActorCriticPolicy
+key naming (mlp_extractor.policy_net/value_net Sequential indices,
+action_net/value_net heads, log_std) — without needing stable_baselines3
+installed. Numeric ground truth is an independent torch forward pass of
+the same tanh MLP, so the kernel-transpose mapping is pinned end-to-end.
+"""
+
+import json
+import sys
+import zipfile
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+torch = pytest.importorskip("torch")
+
+from marl_distributedformation_tpu.compat.sb3_import import (  # noqa: E402
+    import_sb3_checkpoint,
+    sb3_state_dict_to_flax,
+)
+
+OBS_DIM, ACT_DIM, HIDDEN = 8, 2, (64, 64)
+
+
+def _make_sb3_state_dict(seed: int = 0):
+    """Random weights under SB3 ActorCriticPolicy state_dict naming."""
+    g = torch.Generator().manual_seed(seed)
+
+    def t(*shape):
+        return torch.randn(*shape, generator=g)
+
+    state = {"log_std": t(ACT_DIM)}
+    for net in ("policy", "value"):
+        dims = (OBS_DIM,) + HIDDEN
+        for j in range(len(HIDDEN)):
+            # torch.nn.Sequential(Linear, Tanh, Linear, Tanh) indices
+            state[f"mlp_extractor.{net}_net.{2 * j}.weight"] = t(
+                dims[j + 1], dims[j]
+            )
+            state[f"mlp_extractor.{net}_net.{2 * j}.bias"] = t(dims[j + 1])
+    state["action_net.weight"] = t(ACT_DIM, HIDDEN[-1])
+    state["action_net.bias"] = t(ACT_DIM)
+    state["value_net.weight"] = t(1, HIDDEN[-1])
+    state["value_net.bias"] = t(1)
+    return state
+
+
+def _write_sb3_zip(path: Path, state: dict) -> None:
+    import io
+
+    buf = io.BytesIO()
+    torch.save(state, buf)
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("data", json.dumps({"policy_class": "MlpPolicy"}))
+        zf.writestr("policy.pth", buf.getvalue())
+        zf.writestr("_stable_baselines3_version", "2.3.0")
+
+
+def _torch_forward(state: dict, obs: np.ndarray):
+    """Independent ground-truth forward of SB3's separate tanh MLPs."""
+    x = torch.as_tensor(obs, dtype=torch.float32)
+
+    def mlp(net: str, x):
+        for j in range(len(HIDDEN)):
+            w = state[f"mlp_extractor.{net}_net.{2 * j}.weight"]
+            b = state[f"mlp_extractor.{net}_net.{2 * j}.bias"]
+            x = torch.tanh(x @ w.T + b)
+        return x
+
+    mean = mlp("policy", x) @ state["action_net.weight"].T + state[
+        "action_net.bias"
+    ]
+    value = mlp("value", x) @ state["value_net.weight"].T + state[
+        "value_net.bias"
+    ]
+    return mean.numpy(), value.numpy()[..., 0]
+
+
+def test_forward_parity_after_import(tmp_path):
+    """Converted params must reproduce the torch policy's action mean,
+    value, and log_std exactly (f32 tolerance)."""
+    from marl_distributedformation_tpu.models import MLPActorCritic
+
+    state = _make_sb3_state_dict()
+    params, info = sb3_state_dict_to_flax(state)
+    assert info == {"obs_dim": OBS_DIM, "act_dim": ACT_DIM, "hidden": HIDDEN}
+
+    obs = np.random.default_rng(1).standard_normal((32, OBS_DIM)).astype(
+        np.float32
+    )
+    mean_j, log_std_j, value_j = MLPActorCritic(act_dim=ACT_DIM).apply(
+        params, jnp.asarray(obs)
+    )
+    mean_t, value_t = _torch_forward(state, obs)
+    np.testing.assert_allclose(np.asarray(mean_j), mean_t, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(value_j), value_t, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(log_std_j), state["log_std"].numpy(), atol=1e-6
+    )
+
+
+def test_zip_to_playback_roundtrip(tmp_path):
+    """SB3 zip -> converted file named for latest_checkpoint discovery ->
+    LoadedPolicy.predict serves actions from the imported weights."""
+    from marl_distributedformation_tpu.compat import LoadedPolicy
+    from marl_distributedformation_tpu.utils import latest_checkpoint
+
+    state = _make_sb3_state_dict(seed=3)
+    src = tmp_path / "rl_model_123000_steps.zip"
+    _write_sb3_zip(src, state)
+
+    out = import_sb3_checkpoint(src)
+    assert out.name == "rl_model_123000_steps.msgpack"
+    assert latest_checkpoint(tmp_path) == out
+
+    policy = LoadedPolicy.from_checkpoint(out, act_dim=ACT_DIM)
+    obs = np.random.default_rng(2).standard_normal((5, OBS_DIM)).astype(
+        np.float32
+    )
+    actions, _ = policy.predict(obs, deterministic=True)
+    mean_t, _ = _torch_forward(state, obs)
+    np.testing.assert_allclose(
+        actions, np.clip(mean_t, -1.0, 1.0), atol=1e-5
+    )
+
+
+def test_warm_start_resume(tmp_path):
+    """A converted (params-only) checkpoint warm-starts Trainer: params
+    carried over, fresh optimizer state, timestep counter restored."""
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+
+    state = _make_sb3_state_dict(seed=4)
+    src = tmp_path / "rl_model_5000_steps.zip"
+    _write_sb3_zip(src, state)
+    import_sb3_checkpoint(src)
+
+    trainer = Trainer(
+        EnvParams(num_agents=3),
+        config=TrainConfig(
+            num_formations=2,
+            name="sb3_resume",
+            log_dir=str(tmp_path),
+            resume=True,
+            checkpoint=False,
+        ),
+    )
+    assert trainer.num_timesteps == 5000
+    got = np.asarray(trainer.train_state.params["params"]["pi_head"]["kernel"])
+    np.testing.assert_allclose(
+        got, state["action_net.weight"].numpy().T, atol=1e-6
+    )
+    # Fine-tuning proceeds from the imported weights.
+    metrics = trainer.run_iteration()
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_shared_trunk_rejected(tmp_path):
+    state = _make_sb3_state_dict()
+    state["mlp_extractor.shared_net.0.weight"] = torch.zeros(64, OBS_DIM)
+    with pytest.raises(ValueError, match="shared-trunk"):
+        sb3_state_dict_to_flax(state)
+
+
+def test_cli_rejects_output_collisions(tmp_path, capsys):
+    """Two sources mapping to one output path must abort BEFORE any write,
+    and --steps with multiple sources is rejected outright."""
+    from marl_distributedformation_tpu.compat.sb3_import import main
+
+    a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+    a_dir.mkdir(), b_dir.mkdir()
+    src_a = a_dir / "rl_model_100_steps.zip"
+    src_b = b_dir / "rl_model_100_steps.zip"
+    _write_sb3_zip(src_a, _make_sb3_state_dict(seed=5))
+    _write_sb3_zip(src_b, _make_sb3_state_dict(seed=6))
+
+    out_dir = tmp_path / "converted"
+    with pytest.raises(SystemExit):
+        main([str(src_a), str(src_b), "--out-dir", str(out_dir)])
+    assert "collision" in capsys.readouterr().err
+    assert not list(out_dir.glob("*.msgpack"))  # nothing written
+
+    with pytest.raises(SystemExit):
+        main([str(src_a), str(src_b), "--steps", "7"])
+    assert "--steps with multiple sources" in capsys.readouterr().err
+
+
+def test_missing_policy_pth_rejected(tmp_path):
+    bad = tmp_path / "rl_model_1_steps.zip"
+    with zipfile.ZipFile(bad, "w") as zf:
+        zf.writestr("data", "{}")
+    with pytest.raises(ValueError, match="policy.pth"):
+        import_sb3_checkpoint(bad)
