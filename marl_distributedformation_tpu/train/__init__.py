@@ -3,6 +3,10 @@
 from marl_distributedformation_tpu.train.trainer import (  # noqa: F401
     TrainConfig,
     Trainer,
+    make_ppo_iteration,
+)
+from marl_distributedformation_tpu.train.sweep import (  # noqa: F401
+    SweepTrainer,
 )
 from marl_distributedformation_tpu.train.curriculum import (  # noqa: F401
     Curriculum,

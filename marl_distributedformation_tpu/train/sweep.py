@@ -1,0 +1,304 @@
+"""Population training: K independent PPO runs in ONE jitted program.
+
+The reference's stack trains one policy per process — a seed sweep is K
+sequential SB3 invocations (reference vectorized_env.py:112-137 has no
+sweep story at all). Here the whole training iteration
+(``make_ppo_iteration``) is ``vmap``-ed over a leading seed axis: policy
+params, optimizer state, env state, and PRNG streams all carry a ``(K,
+...)`` population dimension, and XLA compiles one program that advances
+every member per dispatch.
+
+TPU mapping: population members are fully independent, so sharding the
+seed axis over the mesh (``mesh={dp: D}``) is embarrassingly parallel —
+XLA inserts ZERO collectives and each chip trains ``K/D`` members. This
+turns one chip's tuned 4096-formation throughput into a multi-chip
+hyperparameter/seed search with perfect scaling, which is the idiomatic
+TPU answer to "train many policies": no multiprocessing, no per-process
+checkpoints to reconcile, one metrics stream.
+
+Seed semantics: member ``i`` uses root key ``PRNGKey(config.seed + i)``
+— bit-identical to a single :class:`Trainer` constructed with
+``seed=config.seed + i`` (pinned by ``tests/test_sweep.py``), so a sweep
+is exactly K reference-parity runs, just fused.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.training.train_state import TrainState
+
+from marl_distributedformation_tpu.algo import PPOConfig
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.env.formation import (
+    compute_obs,
+    reset_batch,
+)
+from marl_distributedformation_tpu.models import MLPActorCritic
+from marl_distributedformation_tpu.train.trainer import (
+    TrainConfig,
+    default_total_timesteps,
+    make_ppo_iteration,
+)
+from marl_distributedformation_tpu.utils import (
+    MetricsLogger,
+    Throughput,
+    repo_root,
+    save_checkpoint,
+)
+
+Array = jax.Array
+
+
+class SweepTrainer:
+    """K-seed population PPO under one jit.
+
+    Args:
+      env_params / ppo / config: as :class:`Trainer`; every member trains
+        the full ``total_timesteps`` budget at identical hyperparameters.
+      num_seeds: population size K.
+      model: policy module shared across members (fresh params per member).
+      mesh: optional ``jax.sharding.Mesh`` whose ``'dp'`` axis shards the
+        seed axis (K must divide by it). Members never communicate, so
+        this composes with any mesh the single-run trainer accepts.
+    """
+
+    def __init__(
+        self,
+        env_params: EnvParams,
+        ppo: PPOConfig = PPOConfig(),
+        config: TrainConfig = TrainConfig(),
+        num_seeds: int = 4,
+        model: Any = None,
+        mesh: Any = None,
+    ) -> None:
+        assert num_seeds >= 1
+        assert jax.process_count() == 1, (
+            "SweepTrainer is single-controller: multi-host sweeps would "
+            "need per-host population construction (parallel/distributed "
+            "covers the single-run path); shard the seed axis over local "
+            "devices via mesh= instead"
+        )
+        self.env_params = env_params
+        self.ppo = ppo
+        self.config = config
+        self.num_seeds = num_seeds
+        self.model = model or MLPActorCritic(
+            act_dim=env_params.act_dim, log_std_init=ppo.log_std_init
+        )
+        self.per_formation = getattr(self.model, "per_formation", False)
+        m = config.num_formations
+
+        if self.per_formation:
+            dummy_obs = jnp.zeros(
+                (1, env_params.num_agents, env_params.obs_dim), jnp.float32
+            )
+        else:
+            dummy_obs = jnp.zeros((1, env_params.obs_dim), jnp.float32)
+
+        model_ref = self.model  # close over the module, not self
+
+        def init_member(seed: Array):
+            # EXACTLY Trainer.__init__'s key discipline so member i ==
+            # Trainer(seed=config.seed + i) bit-for-bit.
+            key = jax.random.PRNGKey(seed)
+            key, k_init, k_env = jax.random.split(key, 3)
+            params = model_ref.init(k_init, dummy_obs)
+            train_state = TrainState.create(
+                apply_fn=model_ref.apply, params=params,
+                tx=ppo.make_optimizer(),
+            )
+            env_state = reset_batch(k_env, env_params, m)
+            obs = compute_obs(env_state.agents, env_state.goal, env_params)
+            return train_state, env_state, obs, key
+
+        seeds = config.seed + jnp.arange(num_seeds)
+        (
+            self.train_state,
+            self.env_state,
+            self.obs,
+            self.key,
+        ) = jax.jit(jax.vmap(init_member))(seeds)
+
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            assert set(mesh.axis_names) == {"dp"}, (
+                f"sweep meshes shard the SEED axis over 'dp' only; got "
+                f"axes {tuple(mesh.axis_names)} — an 'sp' axis would "
+                "replicate every member redundantly across it"
+            )
+            dp = int(mesh.shape["dp"])
+            assert num_seeds % dp == 0, (
+                f"num_seeds={num_seeds} must be divisible by the mesh dp "
+                f"axis ({dp}) so every device holds the same member count"
+            )
+            shard = NamedSharding(mesh, PartitionSpec("dp"))
+            place = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda x: jax.device_put(x, shard), t
+            )
+            self.train_state = place(self.train_state)
+            self.env_state = place(self.env_state)
+            self.obs = place(self.obs)
+            self.key = place(self.key)
+
+        iteration = make_ppo_iteration(
+            env_params, ppo, self.per_formation, None
+        )
+        iteration_pop = jax.vmap(iteration)
+        if mesh is not None:
+            # shard_map over the seed axis, not bare jit-under-mesh: each
+            # device runs its K/D members entirely locally, so per-device
+            # code (the Pallas knn kernels, which the SPMD partitioner
+            # cannot split — see parallel.make_dp_step) keeps working, and
+            # XLA provably inserts zero collectives. One partition spec
+            # broadcasts over every pytree leaf (all carry the leading
+            # seed axis).
+            from jax.sharding import PartitionSpec
+
+            spec = PartitionSpec("dp")
+            iteration_pop = jax.shard_map(
+                iteration_pop,
+                mesh=mesh,
+                in_specs=spec,
+                out_specs=spec,
+                # Collective-free program: the varying-across-mesh checker
+                # buys nothing and trips on pallas outputs (see
+                # parallel/mesh.py).
+                check_vma=False,
+            )
+        self._iteration = jax.jit(iteration_pop, donate_argnums=(0, 1))
+        self.num_timesteps = 0  # per-member agent-transitions (SB3 unit)
+        self._vec_steps_since_save = 0
+        self.num_envs = m * env_params.num_agents
+        self.log_dir = config.log_dir or str(
+            repo_root() / "logs" / config.name
+        )
+
+    # ------------------------------------------------------------------
+
+    @property
+    def total_timesteps(self) -> int:
+        return default_total_timesteps(self.config)
+
+    def run_iteration(self) -> Dict[str, Array]:
+        """One vectorized iteration; metrics values carry a leading (K,)
+        seed axis."""
+        (
+            self.train_state,
+            self.env_state,
+            self.obs,
+            self.key,
+            metrics,
+        ) = self._iteration(
+            self.train_state, self.env_state, self.obs, self.key
+        )
+        self.num_timesteps += self.ppo.n_steps * self.num_envs
+        self._vec_steps_since_save += self.ppo.n_steps
+        return metrics
+
+    def member_state(self, i: int) -> Dict[str, Any]:
+        """Slice member ``i``'s full learner state out of the population —
+        a standard (Trainer-compatible) checkpoint target."""
+        take = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: np.asarray(x[i]), t
+        )
+        return {
+            "policy": self.model.__class__.__name__,
+            "params": take(self.train_state.params),
+            "opt_state": take(self.train_state.opt_state),
+            "key": np.asarray(self.key[i]),
+            "num_timesteps": self.num_timesteps,
+        }
+
+    def save(self) -> None:
+        """Per-member checkpoints under ``{log_dir}/seed{i}/`` — each one
+        plays back / resumes through the standard single-run tooling
+        (``visualize_policy.py name={name}/seed{i}``)."""
+        for i in range(self.num_seeds):
+            save_checkpoint(
+                Path(self.log_dir) / f"seed{i}",
+                self.num_timesteps,
+                self.member_state(i),
+            )
+        self._vec_steps_since_save = 0
+
+    def train(self) -> Dict[str, float]:
+        """Full sweep; logs population-aggregate metrics per rollout and
+        writes per-member checkpoints + a ranking summary at the end.
+        Returns the final aggregate record."""
+        logger = MetricsLogger(
+            self.log_dir,
+            run_name=self.config.name,
+            use_wandb=self.config.use_wandb,
+            use_tensorboard=self.config.use_tensorboard,
+        )
+        meter = Throughput()
+        record: Dict[str, float] = {}
+        iteration = 0
+        metrics = None
+        try:
+            while self.num_timesteps < self.total_timesteps:
+                metrics = self.run_iteration()
+                iteration += 1
+                meter.tick(
+                    self.ppo.n_steps
+                    * self.config.num_formations
+                    * self.num_seeds
+                )
+                if iteration % self.config.log_interval == 0:
+                    host = jax.device_get(metrics)  # one batched pull
+                    record = self._aggregate(host)
+                    record["env_steps_per_sec"] = meter.rate()
+                    logger.log(record, self.num_timesteps)
+                if (
+                    self.config.checkpoint
+                    and self._vec_steps_since_save >= self.config.save_freq
+                ):
+                    self.save()
+            if metrics is not None:
+                # Rank on the FINAL iteration's rewards even when
+                # log_interval didn't land on it — a stale ranking would
+                # disagree with the final checkpoints it points at.
+                final = jax.device_get(metrics)
+                record = self._aggregate(final)
+                record["env_steps_per_sec"] = meter.rate()
+                if self.config.checkpoint:
+                    self.save()
+                    self._write_summary(np.asarray(final["reward"]))
+        finally:
+            logger.close()
+        return record
+
+    def _aggregate(self, host: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """Population means under the CANONICAL metric names (the
+        reference metric-name contract, utils/logging.py — so JSONL
+        consumers and the stdout brief keep working), plus population
+        spread fields."""
+        rewards = np.asarray(host["reward"])
+        record = {k: float(np.mean(v)) for k, v in host.items()}
+        record["reward_best"] = float(rewards.max())
+        record["reward_worst"] = float(rewards.min())
+        record["best_seed"] = int(self.config.seed + rewards.argmax())
+        return record
+
+    def _write_summary(self, rewards: Optional[np.ndarray]) -> None:
+        if rewards is None:
+            return
+        summary = {
+            "seeds": [
+                int(self.config.seed + i) for i in range(self.num_seeds)
+            ],
+            "final_reward": [float(r) for r in rewards],
+            "best_seed": int(self.config.seed + rewards.argmax()),
+            "best_dir": f"seed{int(rewards.argmax())}",
+        }
+        path = Path(self.log_dir) / "sweep_summary.json"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(summary, indent=2))

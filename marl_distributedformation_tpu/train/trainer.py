@@ -66,6 +66,97 @@ class TrainConfig:
     profile_iterations: int = 3
 
 
+def default_total_timesteps(config: "TrainConfig") -> int:
+    """SB3 budget semantics shared by every trainer shell: explicit
+    ``total_timesteps``, else ``5000 * M`` agent-transitions
+    (reference vectorized_env.py:116,134)."""
+    if config.total_timesteps is not None:
+        return config.total_timesteps
+    return 5000 * config.num_formations
+
+
+def make_ppo_iteration(
+    env_params: EnvParams,
+    ppo: PPOConfig,
+    per_formation: bool = False,
+    env_step_fn: Any = None,
+):
+    """Build the functional training iteration: rollout + GAE + all
+    minibatch epochs as one pure function
+    ``(train_state, env_state, obs, key) -> (train_state, env_state,
+    last_obs, key, metrics)``.
+
+    Module-level (not a Trainer method) so other shells can transform it:
+    ``Trainer`` jits it directly; ``SweepTrainer`` (train/sweep.py) vmaps
+    it over a population of seeds before jitting.
+    """
+    if per_formation:
+        # Minibatch whole formations: rows are (N, ...) blocks so the
+        # centralized critic sees every agent. batch_size stays denominated
+        # in agent-transitions for comparable SGD noise across policies.
+        n = env_params.num_agents
+        update_ppo = dataclasses.replace(
+            ppo, batch_size=max(1, ppo.batch_size // n)
+        )
+        row_shape = (n,)
+    else:
+        update_ppo = ppo
+        row_shape = ()
+
+    def iteration(
+        train_state: TrainState,
+        env_state,
+        obs: Array,
+        key: Array,
+    ) -> Tuple[TrainState, Any, Array, Array, Dict[str, Array]]:
+        key, k_roll, k_update = jax.random.split(key, 3)
+        with jax.named_scope("rollout"):
+            env_state, last_obs, batch, last_value = collect_rollout(
+                train_state.apply_fn,
+                train_state.params,
+                env_state,
+                obs,
+                k_roll,
+                env_params,
+                ppo.n_steps,
+                env_step_fn=env_step_fn,
+            )
+        with jax.named_scope("gae"):
+            advantages, returns = compute_gae(
+                batch.rewards,
+                batch.values,
+                batch.dones,
+                last_value,
+                ppo.gamma,
+                ppo.gae_lambda,
+            )
+        flat = MinibatchData(
+            obs=batch.obs.reshape(-1, *row_shape, env_params.obs_dim),
+            actions=batch.actions.reshape(
+                -1, *row_shape, env_params.act_dim
+            ),
+            old_log_probs=batch.log_probs.reshape(-1, *row_shape),
+            advantages=advantages.reshape(-1, *row_shape),
+            returns=returns.reshape(-1, *row_shape),
+        )
+        with jax.named_scope("ppo_update"):
+            train_state, update_metrics = ppo_update(
+                train_state, flat, k_update, update_ppo
+            )
+        metrics = {
+            k: v.mean() for k, v in batch.metrics.items()
+        }
+        metrics.update(update_metrics)
+        metrics["reward"] = batch.rewards.mean()
+        # Formation-level episode count (batch.dones broadcasts the
+        # per-formation done to all N agent rows; same reduction as
+        # HeteroTrainer so the metric's unit matches across trainers).
+        metrics["episode_dones"] = batch.dones[..., 0].sum()
+        return train_state, env_state, last_obs, key, metrics
+
+    return iteration
+
+
 class Trainer:
     """Imperative shell around the functional training core.
 
@@ -183,73 +274,9 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _make_iteration(self):
-        env_params, ppo = self.env_params, self.ppo
-        env_step_fn = self._env_step_fn
-        if self.per_formation:
-            # Minibatch whole formations: rows are (N, ...) blocks so the
-            # centralized critic sees every agent. batch_size stays denominated
-            # in agent-transitions for comparable SGD noise across policies.
-            n = env_params.num_agents
-            update_ppo = dataclasses.replace(
-                ppo, batch_size=max(1, ppo.batch_size // n)
-            )
-            row_shape = (n,)
-        else:
-            update_ppo = ppo
-            row_shape = ()
-
-        def iteration(
-            train_state: TrainState,
-            env_state,
-            obs: Array,
-            key: Array,
-        ) -> Tuple[TrainState, Any, Array, Array, Dict[str, Array]]:
-            key, k_roll, k_update = jax.random.split(key, 3)
-            with jax.named_scope("rollout"):
-                env_state, last_obs, batch, last_value = collect_rollout(
-                    train_state.apply_fn,
-                    train_state.params,
-                    env_state,
-                    obs,
-                    k_roll,
-                    env_params,
-                    ppo.n_steps,
-                    env_step_fn=env_step_fn,
-                )
-            with jax.named_scope("gae"):
-                advantages, returns = compute_gae(
-                    batch.rewards,
-                    batch.values,
-                    batch.dones,
-                    last_value,
-                    ppo.gamma,
-                    ppo.gae_lambda,
-                )
-            flat = MinibatchData(
-                obs=batch.obs.reshape(-1, *row_shape, env_params.obs_dim),
-                actions=batch.actions.reshape(
-                    -1, *row_shape, env_params.act_dim
-                ),
-                old_log_probs=batch.log_probs.reshape(-1, *row_shape),
-                advantages=advantages.reshape(-1, *row_shape),
-                returns=returns.reshape(-1, *row_shape),
-            )
-            with jax.named_scope("ppo_update"):
-                train_state, update_metrics = ppo_update(
-                    train_state, flat, k_update, update_ppo
-                )
-            metrics = {
-                k: v.mean() for k, v in batch.metrics.items()
-            }
-            metrics.update(update_metrics)
-            metrics["reward"] = batch.rewards.mean()
-            # Formation-level episode count (batch.dones broadcasts the
-            # per-formation done to all N agent rows; same reduction as
-            # HeteroTrainer so the metric's unit matches across trainers).
-            metrics["episode_dones"] = batch.dones[..., 0].sum()
-            return train_state, env_state, last_obs, key, metrics
-
-        return iteration
+        return make_ppo_iteration(
+            self.env_params, self.ppo, self.per_formation, self._env_step_fn
+        )
 
     # ------------------------------------------------------------------
     # Imperative shell
@@ -257,9 +284,7 @@ class Trainer:
 
     @property
     def total_timesteps(self) -> int:
-        if self.config.total_timesteps is not None:
-            return self.config.total_timesteps
-        return 5000 * self.config.num_formations  # vectorized_env.py:116,134
+        return default_total_timesteps(self.config)
 
     def run_iteration(self) -> Dict[str, float]:
         """One rollout + update; returns host-side metric floats."""

@@ -1,0 +1,251 @@
+"""Seed-sweep population training (train/sweep.py).
+
+The load-bearing invariant: sweep member i is bit-compatible with a
+single Trainer constructed at seed+i — a sweep IS K reference-parity
+runs, fused into one program. Plus: seed-axis mesh sharding changes
+nothing numerically, and per-member checkpoints flow through the
+standard playback/resume tooling.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from marl_distributedformation_tpu.algo import PPOConfig  # noqa: E402
+from marl_distributedformation_tpu.env import EnvParams  # noqa: E402
+from marl_distributedformation_tpu.parallel import make_mesh  # noqa: E402
+from marl_distributedformation_tpu.train import (  # noqa: E402
+    SweepTrainer,
+    TrainConfig,
+    Trainer,
+)
+
+PPO = PPOConfig(n_steps=4, batch_size=24, n_epochs=2)
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        num_formations=4,
+        seed=0,
+        checkpoint=False,
+        name="sweep",
+        log_dir=str(tmp_path / "logs"),
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _leaves_allclose(a, b, rtol=1e-5, atol=1e-6):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        )
+
+
+def test_member_matches_single_trainer(tmp_path):
+    """Member i of a K=2 sweep == Trainer(seed=i), params and metrics."""
+    params = EnvParams(num_agents=3)
+    sweep = SweepTrainer(
+        params, ppo=PPO, config=_cfg(tmp_path), num_seeds=2
+    )
+    singles = [
+        Trainer(params, ppo=PPO, config=_cfg(tmp_path, seed=i))
+        for i in range(2)
+    ]
+    for _ in range(2):
+        sweep_metrics = sweep.run_iteration()
+        single_metrics = [t.run_iteration() for t in singles]
+    for i, t in enumerate(singles):
+        _leaves_allclose(
+            jax.tree_util.tree_map(
+                lambda x: x[i], sweep.train_state.params
+            ),
+            t.train_state.params,
+        )
+        np.testing.assert_allclose(
+            float(sweep_metrics["reward"][i]),
+            float(single_metrics[i]["reward"]),
+            rtol=1e-5,
+        )
+    # Distinct seeds actually diverge.
+    assert not np.allclose(
+        np.asarray(sweep_metrics["reward"][0]),
+        np.asarray(sweep_metrics["reward"][1]),
+    )
+
+
+@pytest.mark.slow
+def test_seed_axis_sharding_matches_unsharded(tmp_path):
+    """mesh={dp: 4} shards the population with zero numeric effect."""
+    params = EnvParams(num_agents=3)
+    plain = SweepTrainer(params, ppo=PPO, config=_cfg(tmp_path), num_seeds=4)
+    sharded = SweepTrainer(
+        params,
+        ppo=PPO,
+        config=_cfg(tmp_path),
+        num_seeds=4,
+        mesh=make_mesh({"dp": 4}),
+    )
+    for _ in range(2):
+        m_plain = plain.run_iteration()
+        m_shard = sharded.run_iteration()
+    _leaves_allclose(
+        plain.train_state.params, sharded.train_state.params, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_plain["reward"]),
+        np.asarray(m_shard["reward"]),
+        rtol=1e-4,
+    )
+
+
+def test_sweep_rejects_bad_population_split(tmp_path):
+    with pytest.raises(AssertionError, match="divisible"):
+        SweepTrainer(
+            EnvParams(num_agents=3),
+            ppo=PPO,
+            config=_cfg(tmp_path),
+            num_seeds=3,
+            mesh=make_mesh({"dp": 4}),
+        )
+    with pytest.raises(AssertionError, match="'dp'"):
+        SweepTrainer(
+            EnvParams(num_agents=3),
+            ppo=PPO,
+            config=_cfg(tmp_path),
+            num_seeds=4,
+            mesh=make_mesh({"dp": 2, "sp": 2}),
+        )
+
+
+@pytest.mark.slow
+def test_knn_sweep_on_mesh(tmp_path):
+    """knn observations inside a seed-sharded sweep: the shard_map wrap
+    keeps the per-device neighbor search local (the SPMD partitioner never
+    sees it), so this must compile and run."""
+    sweep = SweepTrainer(
+        EnvParams(num_agents=6, obs_mode="knn", knn_k=2),
+        ppo=PPO,
+        config=_cfg(tmp_path),
+        num_seeds=4,
+        mesh=make_mesh({"dp": 4}),
+    )
+    metrics = sweep.run_iteration()
+    assert np.isfinite(np.asarray(metrics["reward"])).all()
+
+
+def test_summary_fresh_despite_sparse_logging(tmp_path):
+    """A run whose iteration count log_interval never divides must still
+    write sweep_summary.json, ranked on the FINAL iteration's rewards."""
+    cfg = _cfg(
+        tmp_path,
+        checkpoint=True,
+        log_interval=10,
+        total_timesteps=3 * PPO.n_steps * 4 * 3,  # 3 iterations
+    )
+    sweep = SweepTrainer(
+        EnvParams(num_agents=3), ppo=PPO, config=cfg, num_seeds=2
+    )
+    record = sweep.train()
+    assert "reward_best" in record
+    summary = json.loads(
+        (Path(sweep.log_dir) / "sweep_summary.json").read_text()
+    )
+    assert len(summary["final_reward"]) == 2
+
+
+def test_periodic_saves_honor_save_freq(tmp_path):
+    """save_freq vec-steps between member checkpoints, like Trainer."""
+    cfg = _cfg(
+        tmp_path,
+        checkpoint=True,
+        save_freq=PPO.n_steps,  # every iteration
+        total_timesteps=2 * PPO.n_steps * 4 * 3,  # 2 iterations
+    )
+    sweep = SweepTrainer(
+        EnvParams(num_agents=3), ppo=PPO, config=cfg, num_seeds=2
+    )
+    sweep.train()
+    ckpts = sorted(
+        p.name for p in (Path(sweep.log_dir) / "seed1").glob("*.msgpack")
+    )
+    assert len(ckpts) == 2, f"expected a checkpoint per iteration: {ckpts}"
+
+
+def test_member_checkpoints_play_back_and_resume(tmp_path):
+    """train() writes per-member checkpoints + ranking summary; a member
+    checkpoint loads through LoadedPolicy and resumes a single Trainer."""
+    from marl_distributedformation_tpu.compat import LoadedPolicy
+
+    params = EnvParams(num_agents=3)
+    cfg = _cfg(
+        tmp_path,
+        checkpoint=True,
+        total_timesteps=2 * PPO.n_steps * 4 * 3,  # 2 iterations
+    )
+    sweep = SweepTrainer(params, ppo=PPO, config=cfg, num_seeds=2)
+    record = sweep.train()
+    assert "reward_best" in record and "best_seed" in record
+
+    summary = json.loads(
+        (Path(sweep.log_dir) / "sweep_summary.json").read_text()
+    )
+    assert summary["best_dir"] in ("seed0", "seed1")
+    assert len(summary["final_reward"]) == 2
+
+    member_dir = Path(sweep.log_dir) / "seed0"
+    ckpts = list(member_dir.glob("rl_model_*_steps.msgpack"))
+    assert ckpts, f"no member checkpoint in {member_dir}"
+
+    policy = LoadedPolicy.from_checkpoint(ckpts[0], act_dim=2)
+    obs = np.zeros((6, params.obs_dim), np.float32)
+    actions, _ = policy.predict(obs)
+    assert actions.shape == (6, 2)
+
+    resumed = Trainer(
+        params,
+        ppo=PPO,
+        config=_cfg(
+            tmp_path, log_dir=str(member_dir), resume=True, checkpoint=False
+        ),
+    )
+    assert resumed.num_timesteps == sweep.num_timesteps
+    _leaves_allclose(
+        resumed.train_state.params,
+        jax.tree_util.tree_map(lambda x: x[0], sweep.train_state.params),
+    )
+
+
+def test_cli_dispatch(tmp_path, monkeypatch):
+    import train as train_cli
+    from marl_distributedformation_tpu.utils import load_config
+
+    cfg = load_config(
+        ["name=sweeptest", "num_seeds=2", "num_formation=4",
+         "num_agents_per_formation=3", "platform=cpu"]
+    )
+    trainer = train_cli.build_trainer(cfg)
+    assert isinstance(trainer, SweepTrainer)
+
+    cfg2 = load_config(
+        ["name=x", "num_seeds=2", "platform=cpu",
+         "curriculum=[{rollouts: 2, agent_counts: [3]}]"]
+    )
+    with pytest.raises(SystemExit, match="curriculum"):
+        train_cli.build_trainer(cfg2)
+
+    cfg3 = load_config(
+        ["name=x", "num_seeds=2", "resume=true", "platform=cpu",
+         "num_formation=4"]
+    )
+    with pytest.raises(SystemExit, match="resume"):
+        train_cli.build_trainer(cfg3)

@@ -83,6 +83,11 @@ def build_trainer(cfg) -> Trainer:
     train_cfg = train_config_from_config(cfg)
     shard_fn = shard_fn_from_config(cfg)
     if cfg.get("curriculum"):
+        if int(cfg.get("num_seeds", 1)) > 1:
+            raise SystemExit(
+                "num_seeds > 1 does not compose with curriculum training; "
+                "run the sweep on a fixed stage instead"
+            )
         return build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn)
     policy = cfg.get("policy", "mlp")
     model = None
@@ -110,6 +115,23 @@ def build_trainer(cfg) -> Trainer:
         raise SystemExit(
             f"policy={cfg.policy!r} is not implemented; available: "
             "mlp, ctde, gnn"
+        )
+    num_seeds = int(cfg.get("num_seeds", 1))
+    if num_seeds > 1:
+        from marl_distributedformation_tpu.train import SweepTrainer
+
+        if train_cfg.resume:
+            raise SystemExit(
+                "num_seeds > 1 does not support resume=true; resume "
+                "individual members via their logs/{name}/seed{i}/ dirs"
+            )
+        return SweepTrainer(
+            env_params,
+            ppo=ppo,
+            config=train_cfg,
+            num_seeds=num_seeds,
+            model=model,
+            mesh=getattr(shard_fn, "mesh", None),
         )
     return Trainer(
         env_params, ppo=ppo, config=train_cfg, model=model, shard_fn=shard_fn
