@@ -64,5 +64,6 @@ def test_dryrun_multichip_8():
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_odd():
     graft.dryrun_multichip(1)

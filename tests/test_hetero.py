@@ -557,6 +557,7 @@ class TestMaskedCTDE:
             not np.allclose(a, b) for a, b in zip(before, after)
         ), "CTDE params did not update under the curriculum"
 
+    @pytest.mark.slow
     def test_train_py_builds_ctde_curriculum(self, tmp_path):
         """The CLI path accepts policy=ctde with a curriculum."""
         import sys
