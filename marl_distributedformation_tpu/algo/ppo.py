@@ -47,10 +47,24 @@ class PPOConfig:
     normalize_advantage: bool = True
     log_std_init: float = 0.0  # parity: the reference's -2 is a no-op (Q5)
 
-    def make_optimizer(self) -> optax.GradientTransformation:
+    def make_optimizer(
+        self, inject_lr: bool = False
+    ) -> optax.GradientTransformation:
+        """The training optimizer (SB3's clipped Adam). ``inject_lr=True``
+        wraps adam in ``optax.inject_hyperparams`` so the learning rate
+        lives in the OPTIMIZER STATE — one shared transform can then serve
+        a vmapped population with per-member rates (train/sweep.py).
+        Single source of truth for the chain: both variants must stay
+        structurally identical apart from the inject wrapper."""
+        adam = (
+            optax.inject_hyperparams(optax.adam)(
+                learning_rate=self.learning_rate, eps=self.adam_eps
+            )
+            if inject_lr
+            else optax.adam(self.learning_rate, eps=self.adam_eps)
+        )
         return optax.chain(
-            optax.clip_by_global_norm(self.max_grad_norm),
-            optax.adam(self.learning_rate, eps=self.adam_eps),
+            optax.clip_by_global_norm(self.max_grad_norm), adam
         )
 
 

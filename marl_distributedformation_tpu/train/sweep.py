@@ -20,6 +20,15 @@ Seed semantics: member ``i`` uses root key ``PRNGKey(config.seed + i)``
 — bit-identical to a single :class:`Trainer` constructed with
 ``seed=config.seed + i`` (pinned by ``tests/test_sweep.py``), so a sweep
 is exactly K reference-parity runs, just fused.
+
+Hyperparameter search: pass ``learning_rates`` (length K) to give every
+member its own learning rate in the same single program. The optimizer is
+wrapped in ``optax.inject_hyperparams`` so the rate lives in the
+OPTIMIZER STATE (an array leaf the vmap batches) rather than the
+transform closure — one shared ``tx`` serves the whole population. These
+members' checkpoints carry params only (their opt_state tree differs from
+the single-run optimizer's; the resume path re-estimates Adam moments,
+same as SB3-imported checkpoints).
 """
 
 from __future__ import annotations
@@ -66,6 +75,9 @@ class SweepTrainer:
       mesh: optional ``jax.sharding.Mesh`` whose ``'dp'`` axis shards the
         seed axis (K must divide by it). Members never communicate, so
         this composes with any mesh the single-run trainer accepts.
+      learning_rates: optional length-K array — per-member learning rates
+        (population hyperparameter search). None keeps every member at
+        ``ppo.learning_rate`` with the exact single-run optimizer.
     """
 
     def __init__(
@@ -76,6 +88,7 @@ class SweepTrainer:
         num_seeds: int = 4,
         model: Any = None,
         mesh: Any = None,
+        learning_rates: Any = None,
     ) -> None:
         assert num_seeds >= 1
         assert jax.process_count() == 1, (
@@ -103,27 +116,58 @@ class SweepTrainer:
 
         model_ref = self.model  # close over the module, not self
 
-        def init_member(seed: Array):
+        self._lr_sweep = learning_rates is not None
+        if self._lr_sweep:
+            lrs = jnp.asarray(learning_rates, jnp.float32).reshape(-1)
+            assert lrs.shape == (num_seeds,), (
+                f"learning_rates must have one entry per member: got "
+                f"{lrs.shape[0]} for num_seeds={num_seeds}"
+            )
+            # One SHARED transform whose rate is optimizer-STATE, so the
+            # vmap can batch it per member (a per-member closure would
+            # need per-member tx callables, which TrainState can't carry).
+            tx = ppo.make_optimizer(inject_lr=True)
+        else:
+            lrs = None
+            tx = ppo.make_optimizer()
+
+        def init_member(seed: Array, lr: Optional[Array] = None):
             # EXACTLY Trainer.__init__'s key discipline so member i ==
             # Trainer(seed=config.seed + i) bit-for-bit.
             key = jax.random.PRNGKey(seed)
             key, k_init, k_env = jax.random.split(key, 3)
             params = model_ref.init(k_init, dummy_obs)
             train_state = TrainState.create(
-                apply_fn=model_ref.apply, params=params,
-                tx=ppo.make_optimizer(),
+                apply_fn=model_ref.apply, params=params, tx=tx
             )
+            if lr is not None:
+                # inject_hyperparams keeps the rate in its state's
+                # hyperparams dict; overwrite it with this member's value.
+                clip_s, inject_s = train_state.opt_state
+                assert hasattr(inject_s, "hyperparams"), (
+                    "expected InjectHyperparamsState second in the chain"
+                )
+                inject_s = inject_s._replace(
+                    hyperparams={
+                        **inject_s.hyperparams, "learning_rate": lr
+                    }
+                )
+                train_state = train_state.replace(
+                    opt_state=(clip_s, inject_s)
+                )
             env_state = reset_batch(k_env, env_params, m)
             obs = compute_obs(env_state.agents, env_state.goal, env_params)
             return train_state, env_state, obs, key
 
         seeds = config.seed + jnp.arange(num_seeds)
+        init_args = (seeds,) if lrs is None else (seeds, lrs)
         (
             self.train_state,
             self.env_state,
             self.obs,
             self.key,
-        ) = jax.jit(jax.vmap(init_member))(seeds)
+        ) = jax.jit(jax.vmap(init_member))(*init_args)
+        self.learning_rates = lrs
 
         self._mesh = mesh
         if mesh is not None:
@@ -209,13 +253,26 @@ class SweepTrainer:
         take = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda x: np.asarray(x[i]), t
         )
-        return {
+        state = {
             "policy": self.model.__class__.__name__,
             "params": take(self.train_state.params),
-            "opt_state": take(self.train_state.opt_state),
             "key": np.asarray(self.key[i]),
             "num_timesteps": self.num_timesteps,
+            # Provenance the single-run resume path checks: fine-tuning a
+            # member at a different rate than it trained with warns loudly.
+            "learning_rate": float(
+                self.learning_rates[i]
+                if self.learning_rates is not None
+                else self.ppo.learning_rate
+            ),
         }
+        if not self._lr_sweep:
+            # lr-sweep members use the inject_hyperparams state tree, which
+            # the single-run optimizer can't restore into — omit it (the
+            # tolerant resume path re-estimates Adam moments, same as
+            # SB3-imported checkpoints).
+            state["opt_state"] = take(self.train_state.opt_state)
+        return state
 
     def save(self) -> None:
         """Per-member checkpoints under ``{log_dir}/seed{i}/`` — each one
@@ -299,6 +356,10 @@ class SweepTrainer:
             "best_seed": int(self.config.seed + rewards.argmax()),
             "best_dir": f"seed{int(rewards.argmax())}",
         }
+        if self.learning_rates is not None:
+            summary["learning_rates"] = [
+                float(lr) for lr in np.asarray(self.learning_rates)
+            ]
         path = Path(self.log_dir) / "sweep_summary.json"
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_text(json.dumps(summary, indent=2))

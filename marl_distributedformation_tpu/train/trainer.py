@@ -484,6 +484,10 @@ class Trainer:
             "opt_state": self.train_state.opt_state,
             "key": self.key,
             "num_timesteps": self.num_timesteps,
+            # Provenance: the rate this state was trained at (sweep member
+            # checkpoints record their per-member rate here; resume warns
+            # on mismatch).
+            "learning_rate": float(self.ppo.learning_rate),
         }
         if not self._multihost:
             # dp-sharded env state is not coordinator-addressable across
@@ -539,6 +543,17 @@ class Trainer:
         # foreign file — silently restarting the counter at 0 would write
         # low-step checkpoints beside high-step ones and reset schedules.
         self.num_timesteps = int(restored["num_timesteps"])
+        ckpt_lr = restored.get("learning_rate")
+        if ckpt_lr is not None and not jnp.isclose(
+            float(ckpt_lr), self.ppo.learning_rate, rtol=1e-6
+        ):
+            print(
+                f"[trainer] WARNING: checkpoint was trained at "
+                f"learning_rate={float(ckpt_lr):g} but this run uses "
+                f"{self.ppo.learning_rate:g} — pass "
+                f"learning_rate={float(ckpt_lr):g} to continue at the "
+                "original rate"
+            )
         if "env_state" in restored:
             self.env_state = restored["env_state"]
             self.obs = restored["obs"]

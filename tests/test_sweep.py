@@ -143,6 +143,123 @@ def test_knn_sweep_on_mesh(tmp_path):
     assert np.isfinite(np.asarray(metrics["reward"])).all()
 
 
+def test_lr_sweep_members_train_at_their_own_rate(tmp_path):
+    """Per-member learning rates: lr=0 freezes that member, a nonzero-lr
+    member matches a single Trainer run at that rate (the inject_hyperparams
+    wrapper must be numerically equivalent to plain adam)."""
+    import dataclasses
+
+    params = EnvParams(num_agents=3)
+    sweep = SweepTrainer(
+        params,
+        ppo=PPO,
+        config=_cfg(tmp_path),
+        num_seeds=2,
+        learning_rates=[0.0, PPO.learning_rate],
+    )
+    frozen_before = jax.tree_util.tree_map(
+        lambda x: np.asarray(x[0]).copy(), sweep.train_state.params
+    )
+    sweep.run_iteration()
+    _leaves_allclose(
+        jax.tree_util.tree_map(lambda x: x[0], sweep.train_state.params),
+        frozen_before,
+        rtol=0,
+        atol=0,
+    )
+
+    single = Trainer(params, ppo=PPO, config=_cfg(tmp_path, seed=1))
+    single.run_iteration()
+    _leaves_allclose(
+        jax.tree_util.tree_map(lambda x: x[1], sweep.train_state.params),
+        single.train_state.params,
+    )
+
+    # Distinct nonzero rates diverge.
+    sweep2 = SweepTrainer(
+        params,
+        ppo=dataclasses.replace(PPO),
+        config=_cfg(tmp_path),
+        num_seeds=2,
+        learning_rates=[1e-4, 1e-2],
+    )
+    for _ in range(2):
+        m = sweep2.run_iteration()
+    assert not np.allclose(
+        np.asarray(m["loss"][0]), np.asarray(m["loss"][1])
+    )
+
+    with pytest.raises(AssertionError, match="one entry per member"):
+        SweepTrainer(
+            params, ppo=PPO, config=_cfg(tmp_path), num_seeds=2,
+            learning_rates=[1e-3],
+        )
+
+
+def test_lr_sweep_member_checkpoint_resumes_params_only(tmp_path):
+    """lr-sweep member checkpoints omit the inject-wrapped opt_state and
+    still warm-start a single Trainer (fresh Adam moments)."""
+    params = EnvParams(num_agents=3)
+    cfg = _cfg(
+        tmp_path,
+        checkpoint=True,
+        total_timesteps=PPO.n_steps * 4 * 3,  # 1 iteration
+    )
+    sweep = SweepTrainer(
+        params, ppo=PPO, config=cfg, num_seeds=2,
+        learning_rates=[1e-3, 1e-2],
+    )
+    sweep.train()
+    summary = json.loads(
+        (Path(sweep.log_dir) / "sweep_summary.json").read_text()
+    )
+    np.testing.assert_allclose(
+        summary["learning_rates"], [1e-3, 1e-2], rtol=1e-6
+    )
+
+    member_dir = Path(sweep.log_dir) / "seed0"
+    resumed = Trainer(
+        params,
+        ppo=PPO,
+        config=_cfg(
+            tmp_path, log_dir=str(member_dir), resume=True, checkpoint=False
+        ),
+    )
+    assert resumed.num_timesteps == sweep.num_timesteps
+    _leaves_allclose(
+        resumed.train_state.params,
+        jax.tree_util.tree_map(lambda x: x[0], sweep.train_state.params),
+    )
+
+
+def test_resume_warns_on_learning_rate_mismatch(tmp_path, capsys):
+    """A member trained at a non-default rate must warn when resumed at
+    a different one (the rate is recorded in the checkpoint)."""
+    params = EnvParams(num_agents=3)
+    cfg = _cfg(
+        tmp_path,
+        checkpoint=True,
+        total_timesteps=PPO.n_steps * 4 * 3,  # 1 iteration
+    )
+    sweep = SweepTrainer(
+        params, ppo=PPO, config=cfg, num_seeds=2,
+        learning_rates=[1e-3, 1e-2],
+    )
+    sweep.train()
+    capsys.readouterr()
+    Trainer(
+        params,
+        ppo=PPO,  # learning_rate=1e-3 != seed1's 1e-2
+        config=_cfg(
+            tmp_path,
+            log_dir=str(Path(sweep.log_dir) / "seed1"),
+            resume=True,
+            checkpoint=False,
+        ),
+    )
+    assert "learning_rate=0.01" in capsys.readouterr().out
+
+
 def test_summary_fresh_despite_sparse_logging(tmp_path):
     """A run whose iteration count log_interval never divides must still
     write sweep_summary.json, ranked on the FINAL iteration's rewards."""

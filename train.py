@@ -82,8 +82,16 @@ def build_trainer(cfg) -> Trainer:
     ppo = ppo_from_config(cfg)
     train_cfg = train_config_from_config(cfg)
     shard_fn = shard_fn_from_config(cfg)
+    num_seeds = int(cfg.get("num_seeds", 1))
+    learning_rates = cfg.get("learning_rates")
+    if learning_rates and num_seeds <= 1:
+        # Validated before any dispatch so no path can silently drop it.
+        raise SystemExit(
+            "learning_rates is a population knob: set num_seeds to the "
+            "number of rates (one member per rate)"
+        )
     if cfg.get("curriculum"):
-        if int(cfg.get("num_seeds", 1)) > 1:
+        if num_seeds > 1:
             raise SystemExit(
                 "num_seeds > 1 does not compose with curriculum training; "
                 "run the sweep on a fixed stage instead"
@@ -116,7 +124,6 @@ def build_trainer(cfg) -> Trainer:
             f"policy={cfg.policy!r} is not implemented; available: "
             "mlp, ctde, gnn"
         )
-    num_seeds = int(cfg.get("num_seeds", 1))
     if num_seeds > 1:
         from marl_distributedformation_tpu.train import SweepTrainer
 
@@ -132,6 +139,7 @@ def build_trainer(cfg) -> Trainer:
             num_seeds=num_seeds,
             model=model,
             mesh=getattr(shard_fn, "mesh", None),
+            learning_rates=learning_rates,
         )
     return Trainer(
         env_params, ppo=ppo, config=train_cfg, model=model, shard_fn=shard_fn
