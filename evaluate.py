@@ -16,6 +16,7 @@ Usage:
 from __future__ import annotations
 
 import json
+import re
 import sys
 
 from marl_distributedformation_tpu.eval import (
@@ -42,7 +43,22 @@ def main(argv=None) -> dict:
 
     ckpt = cfg.get("checkpoint")
     if not ckpt:
-        log_dir = str(repo_root() / "logs" / str(cfg.name))
+        log_dir = repo_root() / "logs" / str(cfg.name)
+        # Strictly seed<N> DIRECTORIES: stray files or backups like
+        # seed0.bak must neither crash the sort nor flip a single run
+        # into sweep mode.
+        member_dirs = sorted(
+            (
+                p for p in log_dir.glob("seed*")
+                if p.is_dir() and re.fullmatch(r"seed\d+", p.name)
+            ),
+            key=lambda p: int(p.name.removeprefix("seed")),
+        )
+        if member_dirs:
+            # Sweep run (train/sweep.py): rank EVERY member by held-out
+            # evaluation on identical initial states — more principled
+            # than sweep_summary.json's training-reward ranking.
+            return eval_sweep(member_dirs, params, m, seed)
         ckpt = latest_checkpoint(log_dir)
         if ckpt is None:
             raise SystemExit(
@@ -82,6 +98,50 @@ def main(argv=None) -> dict:
             rows["policy"]["episode_return_per_agent"]
             > rows["baseline"]["episode_return_per_agent"]
         ),
+    }
+    print(json.dumps(result))
+    return result
+
+
+def eval_sweep(member_dirs, params, m: int, seed: int) -> dict:
+    """Evaluate every sweep member's latest checkpoint plus the baseline
+    and zero policies, all on the same initial states; print a ranked
+    table and emit one JSON line."""
+    rows = {}
+    for d in member_dirs:
+        ckpt = latest_checkpoint(d)
+        if ckpt is None:
+            print(f"[eval] {d.name}: no checkpoint, skipping")
+            continue
+        rows[d.name] = evaluate_checkpoint(str(ckpt), params, m, seed)
+    if not rows:
+        raise SystemExit("no member checkpoints found under seed*/")
+    rows["baseline"] = evaluate(baseline_act_fn(params), params, m, seed)
+    rows["zero"] = evaluate(zero_act_fn(), params, m, seed)
+
+    key = "episode_return_per_agent"
+    ranked = sorted(rows, key=lambda n: rows[n][key], reverse=True)
+    members = [n for n in ranked if n.startswith("seed")]
+    best = members[0]
+    print(f"[eval] sweep: {len(members)} members, M={m} formations x "
+          f"N={params.num_agents} agents, seed={seed}, full episodes")
+    name_w = max(len(n) for n in rows)
+    print(f"{'':<{name_w}} | {key:>26} | final_avg_dist_to_goal")
+    for n in ranked:
+        marker = " <- best member" if n == best else ""
+        print(f"{n:<{name_w}} | {rows[n][key]:>26.2f} | "
+              f"{rows[n]['final_avg_dist_to_goal']:>22.2f}{marker}")
+
+    result = {
+        "sweep_members": len(members),
+        "eval_formations": m,
+        "num_agents": params.num_agents,
+        "seed": seed,
+        "member_returns": {n: rows[n][key] for n in members},
+        "best_member": best,
+        "best_return": rows[best][key],
+        "baseline_return": rows["baseline"][key],
+        "beats_baseline": bool(rows[best][key] > rows["baseline"][key]),
     }
     print(json.dumps(result))
     return result

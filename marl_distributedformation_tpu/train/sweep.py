@@ -118,7 +118,12 @@ class SweepTrainer:
 
         self._lr_sweep = learning_rates is not None
         if self._lr_sweep:
-            lrs = jnp.asarray(learning_rates, jnp.float32).reshape(-1)
+            # float() each element: YAML 1.1 keeps dotless sci-notation
+            # ("3e-4") as STRINGS, so the documented CLI syntax
+            # learning_rates=[3e-4,1e-3] arrives as a list of str.
+            lrs = jnp.asarray(
+                [float(x) for x in np.ravel(learning_rates)], jnp.float32
+            )
             assert lrs.shape == (num_seeds,), (
                 f"learning_rates must have one entry per member: got "
                 f"{lrs.shape[0]} for num_seeds={num_seeds}"

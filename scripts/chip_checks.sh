@@ -45,6 +45,9 @@ cat docs/acceptance/tpu_parity.txt
 echo "== training profile breakdown (parity vs preset=tpu) =="
 python scripts/tpu_profile_breakdown.py 4096
 
+echo "== population sweep amortization (K=8) =="
+python scripts/tpu_sweep_bench.py 8 512
+
 echo "== full bench =="
 python bench.py | tail -1 > /tmp/bench_tpu.json
 cat /tmp/bench_tpu.json

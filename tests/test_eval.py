@@ -115,3 +115,44 @@ def test_evaluate_cli_roundtrip(tmp_path, monkeypatch, capsys):
     ):
         assert key in last_json, key
     assert result["eval_formations"] == 4
+
+
+@pytest.mark.slow
+def test_evaluate_cli_sweep_mode(tmp_path, capsys):
+    """name= pointing at a sweep run evaluates every member and ranks by
+    held-out return."""
+    import sys
+
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import evaluate as evaluate_cli
+    import train as train_cli
+
+    train_cli.main(
+        [
+            "name=evalsweep",
+            "num_seeds=2",
+            "num_formation=4",
+            "total_timesteps=720",
+            "n_steps=4",
+            "batch_size=24",
+            "n_epochs=2",
+            "max_steps=20",
+            "num_agents_per_formation=3",
+            "strict_parity=false",
+        ]
+    )
+    result = evaluate_cli.main(
+        [
+            "name=evalsweep",
+            "eval_formations=4",
+            "max_steps=20",
+            "num_agents_per_formation=3",
+            "strict_parity=false",
+        ]
+    )
+    assert result["sweep_members"] == 2
+    assert set(result["member_returns"]) == {"seed0", "seed1"}
+    assert result["best_member"] in ("seed0", "seed1")
+    assert "baseline_return" in result

@@ -24,6 +24,10 @@ REPO = Path(__file__).resolve().parent.parent
 WORKER = """
 import sys
 
+# The worker runs from a tmp dir and the package may not be pip-installed
+# (fresh machines): the repo root is substituted by the test harness.
+sys.path.insert(0, "__REPO_ROOT__")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -94,7 +98,7 @@ def _free_port() -> int:
 @pytest.mark.slow
 def test_two_process_training_and_broadcast_resume(tmp_path):
     worker = tmp_path / "worker.py"
-    worker.write_text(WORKER)
+    worker.write_text(WORKER.replace("__REPO_ROOT__", str(REPO)))
     log_dir = tmp_path / "logs"
     port = _free_port()
 
