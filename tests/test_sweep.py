@@ -342,6 +342,37 @@ def test_member_checkpoints_play_back_and_resume(tmp_path):
     )
 
 
+@pytest.mark.slow
+def test_sweep_composes_with_ctde_and_gnn(tmp_path):
+    """Population training is policy-agnostic: the per-formation CTDE
+    critic and the knn-graph GNN both train under the seed vmap."""
+    from marl_distributedformation_tpu.models import (
+        CTDEActorCritic,
+        GNNActorCritic,
+    )
+
+    ctde = SweepTrainer(
+        EnvParams(num_agents=3),
+        ppo=PPO,
+        config=_cfg(tmp_path),
+        num_seeds=2,
+        model=CTDEActorCritic(act_dim=2),
+    )
+    m = ctde.run_iteration()
+    assert np.isfinite(np.asarray(m["loss"])).all()
+
+    kp = EnvParams(num_agents=6, obs_mode="knn", knn_k=2)
+    gnn = SweepTrainer(
+        kp,
+        ppo=PPO,
+        config=_cfg(tmp_path),
+        num_seeds=2,
+        model=GNNActorCritic(k=2, act_dim=2, goal_in_obs=kp.goal_in_obs),
+    )
+    m = gnn.run_iteration()
+    assert np.isfinite(np.asarray(m["loss"])).all()
+
+
 def test_cli_dispatch(tmp_path, monkeypatch):
     import train as train_cli
     from marl_distributedformation_tpu.utils import load_config
