@@ -18,14 +18,21 @@ cd "$(dirname "$0")/.."
 echo "== probe =="
 python - <<'EOF'
 import subprocess, sys
-out = subprocess.run(
-    [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-    capture_output=True, text=True, timeout=90,
-)
+try:
+    out = subprocess.run(
+        [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=90,
+    )
+except subprocess.TimeoutExpired:
+    print("probe: TIMEOUT — tunnel down, aborting chip checks")
+    sys.exit(1)
 platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
 print("platform:", platform or out.stderr[-200:])
 sys.exit(0 if platform and platform != "cpu" else 1)
 EOF
+
+echo "== all-paths training smoke (one iteration per path) =="
+python scripts/tpu_smoke.py
 
 echo "== k-NN hardware parity (fused + chunked kernels, f64 anchor) =="
 python tests/tpu_compiled_parity.py | tee /tmp/parity_out.txt

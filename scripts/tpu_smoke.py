@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""One-iteration hardware smoke of EVERY training path on the real chip.
+
+The round-2 lesson (VERDICT.md r2 weak #1) is that CPU tests cannot catch
+device-only failures (bf16 matmul precision, Mosaic lowering rules) — and
+that hardware checks only help if they actually get run. This script is
+the broad companion to tests/tpu_compiled_parity.py's deep k-NN check:
+it drives one full jitted training iteration of every path the framework
+ships — MLP (parity + preset=tpu batch), CTDE, knn+GNN (Pallas kernel
+live), the heterogeneous curriculum, and a seed population — and prints
+one SMOKE_OK/SMOKE_FAIL line each. Run via scripts/chip_checks.sh or:
+
+    python scripts/tpu_smoke.py        # ~2-3 min incl. compiles
+    python scripts/tpu_smoke.py cpu    # off-chip smoke of the script itself
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def run_paths(m: int = 256) -> dict:
+    import jax
+    import numpy as np
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.utils.config import PRESETS
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.models import (
+        CTDEActorCritic,
+        GNNActorCritic,
+    )
+    from marl_distributedformation_tpu.train import (
+        Curriculum,
+        CurriculumStage,
+        HeteroTrainer,
+        SweepTrainer,
+        TrainConfig,
+        Trainer,
+    )
+
+    def cfg(name: str, m: int) -> TrainConfig:
+        return TrainConfig(
+            num_formations=m, checkpoint=False, name=name,
+            log_dir=f"/tmp/smoke-{name}",
+        )
+
+    def one_iteration(trainer):
+        t0 = time.perf_counter()
+        metrics = trainer.run_iteration()
+        loss = metrics.get("loss", metrics.get("reward"))
+        jax.block_until_ready(loss)
+        arr = np.asarray(loss)
+        assert np.isfinite(arr).all(), f"non-finite loss: {arr}"
+        return time.perf_counter() - t0
+
+    paths = {}
+
+    paths["mlp_parity"] = lambda: one_iteration(
+        Trainer(EnvParams(num_agents=5), config=cfg("mlp", m))
+    )
+    # The REAL preset (utils.config.PRESETS), not a hardcoded copy — the
+    # smoke must keep covering whatever config preset=tpu actually runs.
+    paths["mlp_tuned"] = lambda: one_iteration(
+        Trainer(
+            EnvParams(num_agents=5),
+            ppo=PPOConfig(**PRESETS["tpu"]),
+            config=cfg("mlp-tuned", m),
+        )
+    )
+    paths["ctde"] = lambda: one_iteration(
+        Trainer(
+            EnvParams(num_agents=20),
+            model=CTDEActorCritic(act_dim=2),
+            config=cfg("ctde", max(m // 8, 8)),
+        )
+    )
+    knn_params = EnvParams(num_agents=100, obs_mode="knn", knn_k=4)
+    paths["gnn_knn100"] = lambda: one_iteration(
+        Trainer(
+            knn_params,
+            model=GNNActorCritic(
+                k=4, act_dim=2, goal_in_obs=knn_params.goal_in_obs
+            ),
+            config=cfg("gnn", max(m // 8, 8)),
+        )
+    )
+
+    def hetero_path():
+        trainer = HeteroTrainer(
+            curriculum=Curriculum(
+                stages=(
+                    CurriculumStage(rollouts=1, agent_counts=(5,)),
+                    CurriculumStage(
+                        rollouts=1, agent_counts=(5, 20), num_obstacles=2
+                    ),
+                )
+            ),
+            env_params=EnvParams(num_agents=5, max_steps=64),
+            config=cfg("hetero", max(m // 8, 8)),
+        )
+        total = 0.0
+        for stage in trainer.curriculum.stages:
+            trainer.start_stage(stage)
+            total += one_iteration(trainer)
+        return total
+
+    paths["hetero_curriculum"] = hetero_path
+    paths["sweep_k4"] = lambda: one_iteration(
+        SweepTrainer(
+            EnvParams(num_agents=5), config=cfg("sweep", max(m // 4, 8)),
+            num_seeds=4,
+        )
+    )
+
+    device = jax.devices()[0].device_kind
+    results, failed = {}, []
+    for name, fn in paths.items():
+        try:
+            secs = fn()
+            results[name] = round(secs, 3)
+            print(f"SMOKE_OK: {name} on {device} ({secs:.2f}s first "
+                  "iteration incl. compile)", flush=True)
+        except Exception as e:  # noqa: BLE001 — report every path
+            failed.append(name)
+            print(f"SMOKE_FAIL: {name}: {type(e).__name__}: "
+                  f"{e}"[:1500], flush=True)
+    summary = {
+        "metric": "tpu_smoke",
+        "device": device,
+        "paths_ok": sorted(set(results)),
+        "paths_failed": failed,
+        "first_iteration_secs": results,
+    }
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
+def main() -> None:
+    import jax
+
+    cpu = "cpu" in sys.argv[1:]
+    if cpu:
+        jax.config.update("jax_platforms", "cpu")
+    # Off-chip self-smoke shrinks the batch: it checks the script, not
+    # host-CPU throughput.
+    summary = run_paths(m=32 if cpu else 256)
+    if summary["paths_failed"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
