@@ -35,7 +35,6 @@ the separate-networks shape the reference trains.
 from __future__ import annotations
 
 import io
-import json
 import re
 import zipfile
 from pathlib import Path
