@@ -373,6 +373,44 @@ def test_sweep_composes_with_ctde_and_gnn(tmp_path):
     assert np.isfinite(np.asarray(m["loss"])).all()
 
 
+def test_visualize_policy_auto_selects_best_member(
+    tmp_path, monkeypatch, capsys
+):
+    """`visualize_policy.py name=pop` on a sweep run descends into
+    sweep_summary.json's best member."""
+    import visualize_policy
+
+    cfg = _cfg(
+        tmp_path,
+        name="popviz",
+        log_dir=str(tmp_path / "logs" / "popviz"),
+        checkpoint=True,
+        total_timesteps=PPO.n_steps * 4 * 3,  # 1 iteration
+    )
+    sweep = SweepTrainer(
+        EnvParams(num_agents=3), ppo=PPO, config=cfg, num_seeds=2
+    )
+    sweep.train()
+    monkeypatch.setattr(
+        "marl_distributedformation_tpu.utils.repo_root", lambda: tmp_path
+    )
+    args = ["name=popviz", "platform=cpu", "headless=true", "steps=2",
+            "num_agents_per_formation=3"]
+    visualize_policy.main(args)
+    out = capsys.readouterr().out
+    best = json.loads(
+        (Path(sweep.log_dir) / "sweep_summary.json").read_text()
+    )["best_dir"]
+    assert f"playing best member {best}" in out  # THE ranked member
+    assert f"/{best}/rl_model_" in out  # and its checkpoint is loaded
+
+    # Interrupted sweep: members exist, summary doesn't — fall back to
+    # the furthest-trained member instead of claiming nothing exists.
+    (Path(sweep.log_dir) / "sweep_summary.json").unlink()
+    visualize_policy.main(args)
+    assert "furthest-trained member seed" in capsys.readouterr().out
+
+
 def test_cli_dispatch(tmp_path, monkeypatch):
     import train as train_cli
     from marl_distributedformation_tpu.utils import load_config

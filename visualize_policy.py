@@ -35,6 +35,43 @@ def main(argv=None) -> None:
     checkpoint_dir = repo_root() / "logs" / str(cfg.name)
     path = latest_checkpoint(checkpoint_dir)
     if path is None:
+        # Sweep run (train/sweep.py): descend into the ranked-best
+        # member so `name=pop` plays back what sweep_summary.json points
+        # at (members are under seed{i}/; `name=pop/seed3` still works).
+        # An INTERRUPTED sweep has member checkpoints but no summary —
+        # fall back to the furthest-trained member rather than claiming
+        # nothing exists.
+        import json
+        import re
+
+        summary = checkpoint_dir / "sweep_summary.json"
+        members = sorted(
+            (
+                p for p in checkpoint_dir.glob("seed*")
+                if p.is_dir() and re.fullmatch(r"seed\d+", p.name)
+            ),
+            key=lambda p: int(p.name.removeprefix("seed")),
+        )
+        if summary.exists():
+            best = json.loads(summary.read_text())["best_dir"]
+            path = latest_checkpoint(checkpoint_dir / best)
+            if path is not None:
+                print(f"sweep run: playing best member {best}")
+        elif members:
+            candidates = [
+                (latest_checkpoint(d), d.name) for d in members
+            ]
+            candidates = [(p, n) for p, n in candidates if p is not None]
+            if candidates:
+                path, member = max(
+                    candidates,
+                    key=lambda c: int(c[0].stem.split("_")[-2]),
+                )
+                print(
+                    f"sweep run without a final summary (interrupted?): "
+                    f"playing furthest-trained member {member}"
+                )
+    if path is None:
         raise SystemExit(
             f"no rl_model_*_steps checkpoint found in {checkpoint_dir} — "
             f"train first: python train.py name={cfg.name}"
