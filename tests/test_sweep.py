@@ -128,6 +128,22 @@ def test_sweep_rejects_bad_population_split(tmp_path):
 
 
 @pytest.mark.slow
+def test_lr_sweep_on_mesh(tmp_path):
+    """Per-member rates (inject_hyperparams state) under the seed-axis
+    shard_map: the rate array shards with the rest of the population."""
+    sweep = SweepTrainer(
+        EnvParams(num_agents=3),
+        ppo=PPO,
+        config=_cfg(tmp_path),
+        num_seeds=4,
+        mesh=make_mesh({"dp": 4}),
+        learning_rates=[1e-4, 1e-3, 3e-3, 1e-2],
+    )
+    metrics = sweep.run_iteration()
+    assert np.isfinite(np.asarray(metrics["loss"])).all()
+
+
+@pytest.mark.slow
 def test_knn_sweep_on_mesh(tmp_path):
     """knn observations inside a seed-sharded sweep: the shard_map wrap
     keeps the per-device neighbor search local (the SPMD partitioner never
