@@ -173,6 +173,9 @@ class SweepTrainer:
             self.key,
         ) = jax.jit(jax.vmap(init_member))(*init_args)
         self.learning_rates = lrs
+        # Host copy for checkpoint/summary provenance — reading the device
+        # array per member would pay a round trip each (tunneled TPU).
+        self._lrs_host = None if lrs is None else np.asarray(lrs)
 
         self._mesh = mesh
         if mesh is not None:
@@ -252,42 +255,61 @@ class SweepTrainer:
         self._vec_steps_since_save += self.ppo.n_steps
         return metrics
 
-    def member_state(self, i: int) -> Dict[str, Any]:
-        """Slice member ``i``'s full learner state out of the population —
-        a standard (Trainer-compatible) checkpoint target."""
-        take = lambda t: jax.tree_util.tree_map(  # noqa: E731
-            lambda x: np.asarray(x[i]), t
-        )
-        state = {
-            "policy": self.model.__class__.__name__,
-            "params": take(self.train_state.params),
-            "key": np.asarray(self.key[i]),
-            "num_timesteps": self.num_timesteps,
-            # Provenance the single-run resume path checks: fine-tuning a
-            # member at a different rate than it trained with warns loudly.
-            "learning_rate": float(
-                self.learning_rates[i]
-                if self.learning_rates is not None
-                else self.ppo.learning_rate
-            ),
-        }
+    def _host_population(self) -> Dict[str, Any]:
+        """ONE batched device pull of everything checkpoints need — on a
+        tunneled TPU, per-leaf-per-member transfers would pay K x leaves
+        round trips (the trainer-wide rule: sync once, slice on host)."""
+        pull = {"params": self.train_state.params, "key": self.key}
         if not self._lr_sweep:
             # lr-sweep members use the inject_hyperparams state tree, which
             # the single-run optimizer can't restore into — omit it (the
             # tolerant resume path re-estimates Adam moments, same as
             # SB3-imported checkpoints).
-            state["opt_state"] = take(self.train_state.opt_state)
+            pull["opt_state"] = self.train_state.opt_state
+        return jax.device_get(pull)
+
+    def member_state(
+        self, i: int, host: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """Slice member ``i``'s full learner state out of the population —
+        a standard (Trainer-compatible) checkpoint target. Pass ``host``
+        (from ``_host_population``) when saving many members so the
+        device pull happens once."""
+        if host is None:
+            host = self._host_population()
+        # np.array (not asarray): slices of the shared host pull must be
+        # OWNING copies, or every member's checkpoint dict aliases (and
+        # keeps alive) the full K-member tree.
+        take = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: np.array(x[i]), t
+        )
+        state = {
+            "policy": self.model.__class__.__name__,
+            "params": take(host["params"]),
+            "key": np.array(host["key"][i]),
+            "num_timesteps": self.num_timesteps,
+            # Provenance the single-run resume path checks: fine-tuning a
+            # member at a different rate than it trained with warns loudly.
+            "learning_rate": float(
+                self._lrs_host[i]
+                if self._lrs_host is not None
+                else self.ppo.learning_rate
+            ),
+        }
+        if "opt_state" in host:
+            state["opt_state"] = take(host["opt_state"])
         return state
 
     def save(self) -> None:
         """Per-member checkpoints under ``{log_dir}/seed{i}/`` — each one
         plays back / resumes through the standard single-run tooling
         (``visualize_policy.py name={name}/seed{i}``)."""
+        host = self._host_population()
         for i in range(self.num_seeds):
             save_checkpoint(
                 Path(self.log_dir) / f"seed{i}",
                 self.num_timesteps,
-                self.member_state(i),
+                self.member_state(i, host),
             )
         self._vec_steps_since_save = 0
 
@@ -361,9 +383,9 @@ class SweepTrainer:
             "best_seed": int(self.config.seed + rewards.argmax()),
             "best_dir": f"seed{int(rewards.argmax())}",
         }
-        if self.learning_rates is not None:
+        if self._lrs_host is not None:
             summary["learning_rates"] = [
-                float(lr) for lr in np.asarray(self.learning_rates)
+                float(lr) for lr in self._lrs_host
             ]
         path = Path(self.log_dir) / "sweep_summary.json"
         path.parent.mkdir(parents=True, exist_ok=True)
