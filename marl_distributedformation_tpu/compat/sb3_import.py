@@ -38,7 +38,7 @@ import io
 import re
 import zipfile
 from pathlib import Path
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -62,21 +62,28 @@ def _load_policy_state_dict(path: Path) -> Dict[str, np.ndarray]:
             "sb3_import needs torch to read SB3 .zip/.pth checkpoints"
         ) from e
 
+    # Three on-disk shapes: an SB3 PPO.save zip (has a policy.pth entry),
+    # a bare torch state_dict file — which since torch 1.6 is ITSELF a
+    # zip (data.pkl + tensor blobs), so zip-ness alone identifies
+    # nothing — or a legacy pickle.
+    blob = None
     if zipfile.is_zipfile(path):
         with zipfile.ZipFile(path) as zf:
             names = zf.namelist()
-            if "policy.pth" not in names:
+            if "policy.pth" in names:
+                blob = zf.read("policy.pth")
+            elif not any(n.endswith("data.pkl") for n in names):
                 raise ValueError(
-                    f"{path} is a zip but has no policy.pth "
-                    f"(entries: {sorted(names)[:8]}...) — not an SB3 "
-                    "PPO.save artifact?"
+                    f"{path} is a zip with neither policy.pth (SB3 "
+                    f"PPO.save) nor data.pkl (torch state_dict) "
+                    f"(entries: {sorted(names)[:8]}...)"
                 )
-            blob = zf.read("policy.pth")
+    if blob is not None:
+        state = torch.load(
+            io.BytesIO(blob), map_location="cpu", weights_only=True
+        )
     else:
-        blob = Path(path).read_bytes()
-    state = torch.load(
-        io.BytesIO(blob), map_location="cpu", weights_only=True
-    )
+        state = torch.load(path, map_location="cpu", weights_only=True)
     return {k: v.detach().numpy() for k, v in state.items()}
 
 
@@ -216,20 +223,126 @@ def import_sb3_checkpoint(
     return out
 
 
+def flax_params_to_sb3_state_dict(params: dict) -> Dict[str, Any]:
+    """The reverse mapping: ``MLPActorCritic`` flax params -> a torch
+    ``state_dict`` under SB3 ActorCriticPolicy naming.
+
+    Deliberately scoped to the state_dict (a plain ``.pth``), NOT a full
+    ``PPO.save`` zip: SB3's ``data`` entry is a version-dependent custom
+    serialization we cannot produce faithfully without SB3 installed.
+    The state_dict is the stable surface — on the reference stack, load
+    with ``model.policy.load_state_dict(torch.load(path))`` after
+    constructing ``PPO('MlpPolicy', env, ...)`` as usual. Round-trip
+    (export -> import -> identical forward pass) is CI-pinned.
+    """
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is in this image
+        raise ImportError("sb3 export needs torch to write .pth files") from e
+
+    p = params["params"] if "params" in params else params
+
+    def tensor(arr) -> Any:
+        # np.array copies: jax/flax leaves surface as READ-ONLY numpy
+        # views, which torch.from_numpy warns about (and writing through
+        # the tensor would be UB).
+        return torch.from_numpy(np.array(arr, dtype=np.float32))
+
+    def linear(name: str) -> Dict[str, Any]:
+        return {
+            "weight": tensor(np.asarray(p[name]["kernel"]).T),
+            "bias": tensor(p[name]["bias"]),
+        }
+
+    state: Dict[str, Any] = {"log_std": tensor(p["log_std"])}
+    for prefix, net in (("pi", "policy"), ("vf", "value")):
+        j = 0
+        while f"{prefix}_{j}" in p:
+            layer = linear(f"{prefix}_{j}")
+            state[f"mlp_extractor.{net}_net.{2 * j}.weight"] = layer["weight"]
+            state[f"mlp_extractor.{net}_net.{2 * j}.bias"] = layer["bias"]
+            j += 1
+        if j == 0:
+            raise ValueError(
+                f"params carry no {prefix}_0 layer — only MLPActorCritic "
+                "checkpoints export to the SB3 MlpPolicy shape"
+            )
+    head = linear("pi_head")
+    state["action_net.weight"], state["action_net.bias"] = (
+        head["weight"], head["bias"],
+    )
+    head = linear("vf_head")
+    state["value_net.weight"], state["value_net.bias"] = (
+        head["weight"], head["bias"],
+    )
+    return state
+
+
+def export_sb3_state_dict(
+    src: str | Path, out: Optional[str | Path] = None
+) -> Path:
+    """Export a framework checkpoint's policy to ``{stem}.sb3.pth``."""
+    import torch
+    from flax import serialization
+
+    src = Path(src)
+    raw = serialization.msgpack_restore(src.read_bytes())
+    policy = raw.get("policy", "MLPActorCritic")
+    if policy != "MLPActorCritic":
+        raise ValueError(
+            f"checkpoint policy {policy!r} has no SB3 equivalent; only "
+            "MLPActorCritic maps onto 'MlpPolicy'"
+        )
+    state = flax_params_to_sb3_state_dict(raw["params"])
+    out = Path(out) if out is not None else src.with_suffix(".sb3.pth")
+    out.parent.mkdir(parents=True, exist_ok=True)
+    torch.save(state, out)
+    return out
+
+
 def main(argv: Optional[list] = None) -> None:
     import argparse
 
     ap = argparse.ArgumentParser(
         description="Convert SB3 PPO checkpoints (rl_model_*_steps.zip) "
-        "to framework checkpoints for playback/eval/fine-tuning."
+        "to framework checkpoints for playback/eval/fine-tuning — or, "
+        "with --export, framework checkpoints back to torch state_dicts "
+        "under SB3 MlpPolicy naming."
     )
-    ap.add_argument("src", nargs="+", help="SB3 .zip (or bare policy .pth)")
+    ap.add_argument("src", nargs="+", help="SB3 .zip (or bare policy "
+                    ".pth); with --export: framework .msgpack checkpoints")
     ap.add_argument("--out-dir", default=None, help="output directory "
                     "(default: next to each source file)")
     ap.add_argument("--steps", type=int, default=None,
                     help="override num_timesteps (default: parsed from "
                     "the rl_model_{steps}_steps filename)")
+    ap.add_argument("--export", action="store_true",
+                    help="reverse direction: framework checkpoint -> "
+                    "{stem}.sb3.pth torch state_dict (load on the "
+                    "reference stack via policy.load_state_dict)")
     args = ap.parse_args(argv)
+    if args.export:
+        if args.steps is not None:
+            ap.error("--steps does not apply to --export")
+        # Same pre-write collision guard as the import path: two sources
+        # with one stem under --out-dir must not silently clobber.
+        planned_out: Dict[Path, str] = {}
+        for src in args.src:
+            dest = (
+                Path(args.out_dir) / (Path(src).stem + ".sb3.pth")
+                if args.out_dir is not None
+                else Path(src).with_suffix(".sb3.pth")
+            )
+            if dest in planned_out:
+                ap.error(
+                    f"output collision: {src} and {planned_out[dest]} "
+                    f"both map to {dest}"
+                )
+            planned_out[dest] = src
+        for dest, src in planned_out.items():
+            out = export_sb3_state_dict(src, dest)
+            print(f"{src} -> {out}")
+        return
     if args.steps is not None and len(args.src) > 1:
         ap.error("--steps with multiple sources would write every input "
                  "to the same rl_model_{steps}_steps.msgpack")

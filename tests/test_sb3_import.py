@@ -193,6 +193,58 @@ def test_cli_rejects_output_collisions(tmp_path, capsys):
     assert "--steps with multiple sources" in capsys.readouterr().err
 
 
+def test_export_roundtrip(tmp_path):
+    """Framework checkpoint -> SB3-named state_dict -> re-import yields
+    bit-identical params (the two mappings are exact inverses)."""
+    import jax
+
+    from flax import serialization
+    from marl_distributedformation_tpu.compat.sb3_import import (
+        export_sb3_state_dict,
+        _load_policy_state_dict,
+    )
+    from marl_distributedformation_tpu.models import MLPActorCritic
+
+    model = MLPActorCritic(act_dim=ACT_DIM)
+    params = model.init(
+        jax.random.PRNGKey(9), np.zeros((1, OBS_DIM), np.float32)
+    )
+    ckpt = tmp_path / "rl_model_42_steps.msgpack"
+    ckpt.write_bytes(
+        serialization.msgpack_serialize(
+            {"policy": "MLPActorCritic", "params": params,
+             "num_timesteps": 42}
+        )
+    )
+    out = export_sb3_state_dict(ckpt)
+    assert out.name == "rl_model_42_steps.sb3.pth"
+
+    reimported, info = sb3_state_dict_to_flax(_load_policy_state_dict(out))
+    flat_a = jax.tree_util.tree_leaves_with_path(params)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(reimported))
+    for path, leaf in flat_a:
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(flat_b[path]), err_msg=str(path)
+        )
+    assert info["obs_dim"] == OBS_DIM
+
+
+def test_export_rejects_non_mlp(tmp_path):
+    from flax import serialization
+    from marl_distributedformation_tpu.compat.sb3_import import (
+        export_sb3_state_dict,
+    )
+
+    ckpt = tmp_path / "rl_model_1_steps.msgpack"
+    ckpt.write_bytes(
+        serialization.msgpack_serialize(
+            {"policy": "GNNActorCritic", "params": {"params": {}}}
+        )
+    )
+    with pytest.raises(ValueError, match="no SB3 equivalent"):
+        export_sb3_state_dict(ckpt)
+
+
 def test_missing_policy_pth_rejected(tmp_path):
     bad = tmp_path / "rl_model_1_steps.zip"
     with zipfile.ZipFile(bad, "w") as zf:
