@@ -16,7 +16,7 @@ whole update one XLA program; with default sizes the remainder is zero.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,7 @@ class PPOConfig:
     gamma: float = 0.99
     gae_lambda: float = 0.95
     clip_range: float = 0.2
+    clip_range_vf: Optional[float] = None  # SB3 default: no value clipping
     n_epochs: int = 10
     batch_size: int = 64
     vf_coef: float = 0.5
@@ -132,6 +133,18 @@ def ppo_loss(
     )
     policy_loss = -_wmean(jnp.minimum(unclipped, clipped), w)
 
+    if config.clip_range_vf is not None:
+        # SB3's value clipping: predictions move at most clip_range_vf
+        # from the rollout-time values. Those old values need no extra
+        # plumbing — GAE's identity returns = advantages + values means
+        # old_values = returns - advantages (both raw in the minibatch;
+        # normalization above works on a local copy).
+        old_values = mb.returns - mb.advantages
+        values = old_values + jnp.clip(
+            values - old_values,
+            -config.clip_range_vf,
+            config.clip_range_vf,
+        )
     value_loss = _wmean((mb.returns - values) ** 2, w)
     entropy_loss = -ent  # state-independent Gaussian: scalar
 

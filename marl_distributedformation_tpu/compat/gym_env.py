@@ -101,7 +101,12 @@ class FormationGymEnv(gym.Env):
         )
         self._state, tr = self._step_fn(self._state, jax.numpy.asarray(act))
         self._steps += 1
-        done = bool(np.asarray(tr.done)[0])
+        # ONE device fetch for the whole transition: per-field np.asarray
+        # would pay ~a dozen blocking round trips per step (obs, reward,
+        # done, each metric) — ruinous on a tunneled device for exactly
+        # the per-step external training loops this adapter serves.
+        tr = jax.device_get(tr)
+        done = bool(tr.done[0])
         # Timeout-only episodes (Q3) are truncation in gymnasium terms. A
         # true goal termination exists only off-parity — and even there a
         # done at the step limit is still the timeout (formation.py ORs
@@ -117,7 +122,7 @@ class FormationGymEnv(gym.Env):
         truncated = done and not terminated
         info: Dict[str, Any] = {
             "steps": self._steps,
-            **{k: float(np.asarray(v)[0]) for k, v in tr.metrics.items()},
+            **{k: float(v[0]) for k, v in tr.metrics.items()},
         }
         if done:
             self._steps = 0  # the underlying env auto-reset (see module doc)
@@ -125,7 +130,7 @@ class FormationGymEnv(gym.Env):
             self.render()
         return (
             np.asarray(tr.obs[0], np.float32),
-            float(np.asarray(tr.reward)[0].mean()),
+            float(tr.reward[0].mean()),
             terminated,
             truncated,
             info,
@@ -158,6 +163,13 @@ class FormationGymEnv(gym.Env):
             fig.canvas.draw()
             buf = np.asarray(fig.canvas.buffer_rgba())
             return buf[..., :3].copy()
+        # human: update() only moves artists — flush them to the screen
+        # (plt.pause runs the GUI event loop one tick, the standard
+        # incremental-display idiom).
+        import matplotlib.pyplot as plt
+
+        self._renderer.fig.canvas.draw_idle()
+        plt.pause(0.001)
         return None
 
     def close(self) -> None:

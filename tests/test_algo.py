@@ -123,6 +123,60 @@ def test_ppo_loss_clipping_engages():
     assert float(metrics["clip_fraction"]) > 0.9
 
 
+def test_value_clipping_semantics():
+    """clip_range_vf (SB3's optional value clipping): None reproduces the
+    unclipped loss exactly, a huge range is a no-op, and range 0 pins the
+    value loss at MSE(returns, old_values) with ZERO critic gradient —
+    old_values recovered from the GAE identity returns - advantages."""
+    ts, config = _make_train_state()
+    mb = _make_batch(ts, jax.random.PRNGKey(5))
+
+    import dataclasses
+
+    loss_none, m_none = ppo_loss(ts.params, ts.apply_fn, mb, config)
+    loss_huge, _ = ppo_loss(
+        ts.params, ts.apply_fn, mb,
+        dataclasses.replace(config, clip_range_vf=1e9),
+    )
+    np.testing.assert_allclose(
+        float(loss_none), float(loss_huge), rtol=1e-6
+    )
+
+    # Evaluate at PERTURBED params: the fixture builds returns from ts's
+    # own values, so at ts the prediction sits exactly on the clip
+    # boundary (values == old_values), where clip's subgradient passes
+    # through — only away from the boundary does clipping bite.
+    ts2, _ = _make_train_state(seed=1)
+    cfg0 = dataclasses.replace(config, clip_range_vf=0.0)
+    _, m0 = ppo_loss(ts2.params, ts2.apply_fn, mb, cfg0)
+    old_values = np.asarray(mb.returns - mb.advantages)
+    np.testing.assert_allclose(
+        float(m0["value_loss"]),
+        float(((np.asarray(mb.returns) - old_values) ** 2).mean()),
+        rtol=1e-5,
+    )
+    grads = jax.grad(lambda p: ppo_loss(p, ts2.apply_fn, mb, cfg0)[0])(
+        ts2.params
+    )
+    vf_grad = np.abs(
+        np.asarray(grads["params"]["vf_head"]["kernel"])
+    ).max()
+    assert vf_grad == 0.0, f"critic grad must vanish at clip 0: {vf_grad}"
+
+    # Mid-range: hand-computed clipped MSE.
+    cfg_mid = dataclasses.replace(config, clip_range_vf=0.05)
+    _, m_mid = ppo_loss(ts2.params, ts2.apply_fn, mb, cfg_mid)
+    _, _, values = ts2.apply_fn(ts2.params, mb.obs)
+    clipped = old_values + np.clip(
+        np.asarray(values) - old_values, -0.05, 0.05
+    )
+    np.testing.assert_allclose(
+        float(m_mid["value_loss"]),
+        float(((np.asarray(mb.returns) - clipped) ** 2).mean()),
+        rtol=1e-5,
+    )
+
+
 def test_ppo_update_improves_loss_and_changes_params():
     ts, config = _make_train_state()
     data = _make_batch(ts, jax.random.PRNGKey(4), n=256)

@@ -32,6 +32,7 @@ def ppo_from_config(cfg) -> PPOConfig:
         gamma=cfg.gamma,
         gae_lambda=cfg.gae_lambda,
         clip_range=cfg.clip_range,
+        clip_range_vf=cfg.get("clip_range_vf"),
         n_epochs=cfg.n_epochs,
         batch_size=cfg.batch_size,
         vf_coef=cfg.vf_coef,
