@@ -164,6 +164,21 @@ class SweepTrainer:
             obs = compute_obs(env_state.agents, env_state.goal, env_params)
             return train_state, env_state, obs, key
 
+        self._mesh = mesh
+        if mesh is not None:
+            # Validate the mesh BEFORE the population init: compiling the
+            # vmapped init just to then fail an assert wastes ~10s.
+            assert set(mesh.axis_names) == {"dp"}, (
+                f"sweep meshes shard the SEED axis over 'dp' only; got "
+                f"axes {tuple(mesh.axis_names)} — an 'sp' axis would "
+                "replicate every member redundantly across it"
+            )
+            dp = int(mesh.shape["dp"])
+            assert num_seeds % dp == 0, (
+                f"num_seeds={num_seeds} must be divisible by the mesh dp "
+                f"axis ({dp}) so every device holds the same member count"
+            )
+
         seeds = config.seed + jnp.arange(num_seeds)
         init_args = (seeds,) if lrs is None else (seeds, lrs)
         (
@@ -177,20 +192,9 @@ class SweepTrainer:
         # array per member would pay a round trip each (tunneled TPU).
         self._lrs_host = None if lrs is None else np.asarray(lrs)
 
-        self._mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            assert set(mesh.axis_names) == {"dp"}, (
-                f"sweep meshes shard the SEED axis over 'dp' only; got "
-                f"axes {tuple(mesh.axis_names)} — an 'sp' axis would "
-                "replicate every member redundantly across it"
-            )
-            dp = int(mesh.shape["dp"])
-            assert num_seeds % dp == 0, (
-                f"num_seeds={num_seeds} must be divisible by the mesh dp "
-                f"axis ({dp}) so every device holds the same member count"
-            )
             shard = NamedSharding(mesh, PartitionSpec("dp"))
             place = lambda t: jax.tree_util.tree_map(  # noqa: E731
                 lambda x: jax.device_put(x, shard), t

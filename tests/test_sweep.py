@@ -212,6 +212,7 @@ def test_lr_sweep_members_train_at_their_own_rate(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_lr_sweep_member_checkpoint_resumes_params_only(tmp_path):
     """lr-sweep member checkpoints omit the inject-wrapped opt_state and
     still warm-start a single Trainer (fresh Adam moments)."""
@@ -248,6 +249,7 @@ def test_lr_sweep_member_checkpoint_resumes_params_only(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_resume_warns_on_learning_rate_mismatch(tmp_path, capsys):
     """A member trained at a non-default rate must warn when resumed at
     a different one (the rate is recorded in the checkpoint)."""
@@ -276,6 +278,7 @@ def test_resume_warns_on_learning_rate_mismatch(tmp_path, capsys):
     assert "learning_rate=0.01" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_summary_fresh_despite_sparse_logging(tmp_path):
     """A run whose iteration count log_interval never divides must still
     write sweep_summary.json, ranked on the FINAL iteration's rewards."""
@@ -296,6 +299,7 @@ def test_summary_fresh_despite_sparse_logging(tmp_path):
     assert len(summary["final_reward"]) == 2
 
 
+@pytest.mark.slow
 def test_periodic_saves_honor_save_freq(tmp_path):
     """save_freq vec-steps between member checkpoints, like Trainer."""
     cfg = _cfg(
@@ -314,6 +318,7 @@ def test_periodic_saves_honor_save_freq(tmp_path):
     assert len(ckpts) == 2, f"expected a checkpoint per iteration: {ckpts}"
 
 
+@pytest.mark.slow
 def test_member_checkpoints_play_back_and_resume(tmp_path):
     """train() writes per-member checkpoints + ranking summary; a member
     checkpoint loads through LoadedPolicy and resumes a single Trainer."""
@@ -389,6 +394,7 @@ def test_sweep_composes_with_ctde_and_gnn(tmp_path):
     assert np.isfinite(np.asarray(m["loss"])).all()
 
 
+@pytest.mark.slow
 def test_visualize_policy_auto_selects_best_member(
     tmp_path, monkeypatch, capsys
 ):
