@@ -40,6 +40,8 @@ def test_bench_emits_parseable_json_on_cpu(monkeypatch, capsys):
     monkeypatch.setattr(bench_mod, "MIN_TIMED_S", 0.05)
     monkeypatch.setenv("BENCH_TRAIN_M", "4")
     monkeypatch.setenv("BENCH_KNN_M", "4")
+    monkeypatch.setenv("BENCH_KNN_BIG_M", "2")
+    monkeypatch.setenv("BENCH_KNN_BIG_N", "300")
     bench_mod.main()
     line = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(line)
@@ -47,6 +49,8 @@ def test_bench_emits_parseable_json_on_cpu(monkeypatch, capsys):
     assert rec["value"] > 0
     assert rec["train_env_steps_per_sec"] > 0
     assert rec["knn_env_steps_per_sec"] > 0
+    assert rec["knn_big_env_steps_per_sec"] > 0  # phase 4 emits too
+    assert "error" not in rec and "notes" not in rec
 
 
 def test_graft_entry_compiles():
