@@ -373,31 +373,56 @@ def main() -> None:
                 notes.append(f"{prefix} phase failed: {e!r}"[:200])
 
         # Phase 3 — large-swarm knn variant (BASELINE.json config 4).
+        # Provenance (VERDICT.md r2 weak #4 / r3 weak #5): the committed
+        # hardware-parity status of the pallas/xla kernel pairs
+        # (docs/acceptance/tpu_parity.txt, written by
+        # tests/tpu_compiled_parity.py on the chip). These REPLAY a
+        # committed artifact, not a same-run measurement — each line is
+        # dated so a CPU-fallback JSON can't be misread as live TPU
+        # parity, and each phase below attaches only the artifact legs
+        # for the kernel it actually benchmarks (fused vs pallas_big).
+        # Any recorded PARITY_FAIL leg wins over OK legs so a failure
+        # can never be masked by line position.
+        def parity_claim(legs, stamp, pick=0):
+            failed = [s for s in legs if "PARITY_OK" not in s]
+            return (stamp + (failed[0] if failed else legs[pick]))[:200]
+
+        parity_file = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "docs", "acceptance", "tpu_parity.txt",
+        )
+        try:
+            status, recorded = [], None
+            with open(parity_file) as pf:
+                for ln in pf:
+                    if ln.startswith("# date:"):
+                        recorded = ln.split(":", 1)[1].strip()
+                    elif ln.startswith("PARITY"):
+                        status.append(ln.strip())
+            stamp = f"recorded {recorded or 'undated'}: "
+            # The artifact's big-kernel leg is "pallas_big ..." on success
+            # but "PARITY_FAIL(big): ..." on failure
+            # (tests/tpu_compiled_parity.py:155-163) — match both so a
+            # big-kernel failure routes to the knn-big phase, not fused.
+            big_legs = [
+                s for s in status
+                if "pallas_big" in s or s.startswith("PARITY_FAIL(big)")
+            ]
+            fused_legs = [s for s in status if s not in big_legs]
+        except OSError:
+            stamp, fused_legs, big_legs = None, [], []
+
         if os.environ.get("BENCH_SKIP_KNN") != "1":
             if time.time() < deadline - 30:
                 run_knn_phase(
                     "knn", 100, 4096 if on_accel else 256,
                     max(CHUNK // 8, 16),
                 )
-                # Provenance (VERDICT.md r2 weak #4): the committed
-                # hardware-parity status of the pallas/xla pair
-                # (docs/acceptance/tpu_parity.txt, written by
-                # tests/tpu_compiled_parity.py on the chip).
-                parity_file = os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    "docs", "acceptance", "tpu_parity.txt",
+                result["knn_device_parity"] = (
+                    parity_claim(fused_legs, stamp) if fused_legs
+                    else "no committed artifact" if stamp is None
+                    else "no fused-kernel leg in artifact"
                 )
-                try:
-                    with open(parity_file) as pf:
-                        status = [
-                            ln.strip() for ln in pf
-                            if ln.startswith("PARITY")
-                        ]
-                    result["knn_device_parity"] = (
-                        status[-1][:160] if status else "artifact empty"
-                    )
-                except OSError:
-                    result["knn_device_parity"] = "no committed artifact"
             else:
                 notes.append("knn phase skipped: deadline")
 
@@ -411,6 +436,11 @@ def main() -> None:
                     _env_int("BENCH_KNN_BIG_N", 1024),
                     512 if on_accel else 32,
                     max(CHUNK // 32, 8),
+                )
+                result["knn_big_device_parity"] = (
+                    parity_claim(big_legs, stamp, pick=-1) if big_legs
+                    else "no committed artifact" if stamp is None
+                    else "no big-kernel leg in artifact"
                 )
             else:
                 notes.append("knn-big phase skipped: deadline")

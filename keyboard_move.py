@@ -6,7 +6,11 @@ info) is printed for human inspection of the env contract (README.md:10-12).
 
 Uses matplotlib's native key events instead of the reference's pynput
 global-listener thread — same keys, no second thread mutating env state
-(SURVEY.md §3.4). Extras: ``num_agents=K``, ``platform=cpu``.
+(SURVEY.md §3.4). One behavioral caveat: the reference's listener is
+system-global (keyboard_move.py:47 captures keys from any window), while
+mpl key events only arrive when **the figure window has focus** — click
+the plot first if keys seem dead. Extras: ``num_agents=K``,
+``platform=cpu``.
 """
 
 from __future__ import annotations
@@ -72,6 +76,7 @@ def main(argv=None) -> None:
     renderer.fig.canvas.mpl_connect("key_press_event", on_key)
     print(f"Press 0-{num_agents - 1} to choose which agent to move.")
     print("Arrow keys move the selected agent; ESC exits.")
+    print("(Keys go to the figure window — click the plot to focus it.)")
     plt.show()
 
 

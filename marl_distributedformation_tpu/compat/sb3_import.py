@@ -116,7 +116,13 @@ def sb3_state_dict_to_flax(
             net, idx, part = m.group(1), int(m.group(2)), m.group(3)
             hidden[net].setdefault(idx, {})[part] = arr
 
-    for head in ("action_net.weight", "value_net.weight", "log_std"):
+    for head in (
+        "action_net.weight",
+        "action_net.bias",
+        "value_net.weight",
+        "value_net.bias",
+        "log_std",
+    ):
         if head not in state:
             raise ValueError(
                 f"SB3 checkpoint missing {head!r} — keys: "
@@ -140,6 +146,12 @@ def sb3_state_dict_to_flax(
                 f"SB3 checkpoint has no mlp_extractor.{net}_net layers"
             )
         for j, layer in enumerate(layers):
+            if "weight" not in layer or "bias" not in layer:
+                raise ValueError(
+                    f"SB3 checkpoint's mlp_extractor.{net}_net layer {j} "
+                    f"is missing {'bias' if 'bias' not in layer else 'weight'}"
+                    " — malformed state_dict"
+                )
             params[f"{prefix}_{j}"] = dense(layer["weight"], layer["bias"])
         if net == "policy":
             widths = [layer["weight"].shape[0] for layer in layers]
@@ -149,8 +161,9 @@ def sb3_state_dict_to_flax(
                               state["value_net.bias"])
     params["log_std"] = np.asarray(state["log_std"]).reshape(-1)
 
+    first_pi = hidden["policy"][min(hidden["policy"])]
     info = {
-        "obs_dim": int(state["mlp_extractor.policy_net.0.weight"].shape[1]),
+        "obs_dim": int(first_pi["weight"].shape[1]),
         "act_dim": int(state["action_net.weight"].shape[0]),
         "hidden": tuple(widths),
     }

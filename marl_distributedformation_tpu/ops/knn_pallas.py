@@ -63,7 +63,8 @@ def fits_vmem(n: int) -> bool:
 # chunks (compile time grows O(N * k^2 / chunk_c)). 16384 points keeps the
 # planes at ~200 KB and the unroll at 32 chunks; beyond that "auto" falls
 # back to XLA (explicit impl="pallas_big" still allowed for larger N —
-# VMEM holds to ~1M points, but expect long compiles).
+# after Mosaic pads the singleton sublane axis to 8 the planes cost
+# ~96 B/point, so VMEM holds to ~10^5 points; expect long compiles).
 _BIG_KERNEL_AUTO_MAX_N = 16384
 
 

@@ -170,6 +170,20 @@ def test_shared_trunk_rejected(tmp_path):
         sb3_state_dict_to_flax(state)
 
 
+def test_malformed_checkpoints_fail_descriptively():
+    """Missing biases (head or hidden) must raise the descriptive
+    ValueError path, not a bare KeyError (ADVICE r3)."""
+    for victim in ("action_net.bias", "value_net.bias"):
+        state = _make_sb3_state_dict()
+        del state[victim]
+        with pytest.raises(ValueError, match=victim):
+            sb3_state_dict_to_flax(state)
+    state = _make_sb3_state_dict()
+    del state["mlp_extractor.policy_net.0.bias"]
+    with pytest.raises(ValueError, match="missing bias"):
+        sb3_state_dict_to_flax(state)
+
+
 def test_cli_rejects_output_collisions(tmp_path, capsys):
     """Two sources mapping to one output path must abort BEFORE any write,
     and --steps with multiple sources is rejected outright."""

@@ -426,6 +426,15 @@ def test_visualize_policy_auto_selects_best_member(
     assert f"playing best member {best}" in out  # THE ranked member
     assert f"/{best}/rl_model_" in out  # and its checkpoint is loaded
 
+    # Summary exists but its best_dir checkpoint was deleted by hand —
+    # fall through to the members scan, not "no checkpoint" (ADVICE r3).
+    for p in (Path(sweep.log_dir) / best).glob("rl_model_*_steps*"):
+        p.unlink()
+    visualize_policy.main(args)
+    out = capsys.readouterr().out
+    assert "best member missing" in out
+    assert "furthest-trained member seed" in out
+
     # Interrupted sweep: members exist, summary doesn't — fall back to
     # the furthest-trained member instead of claiming nothing exists.
     (Path(sweep.log_dir) / "sweep_summary.json").unlink()

@@ -187,6 +187,29 @@ def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn):
     )
 
 
+def _snapshot_config(cfg, log_dir) -> None:
+    """Save the resolved run config to ``logs/{name}/config.json`` — the
+    analog of hydra's per-run ``.hydra/config.yaml`` snapshot (the
+    reference gets one implicitly via ``@hydra.main``; see
+    docs/migration.md 'Run directory'). Only process 0 writes. A
+    ``resume=true`` invocation never writes the canonical file —
+    ``config.json`` always describes the config the run was originally
+    trained with; resumes snapshot to ``config_resume.json`` (latest
+    resume wins)."""
+    import json
+    from pathlib import Path
+
+    from marl_distributedformation_tpu.parallel import is_coordinator
+
+    if not is_coordinator():
+        return
+    path = Path(log_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    name = "config_resume.json" if cfg.get("resume") else "config.json"
+    with open(path / name, "w") as f:
+        json.dump(dict(cfg), f, indent=2, default=str)
+
+
 def main(argv=None) -> None:
     cfg = load_config(sys.argv[1:] if argv is None else argv)
     setup_platform(cfg.get("platform"))
@@ -201,6 +224,7 @@ def main(argv=None) -> None:
             f"of {len(jax.devices())} global devices"
         )
     trainer = build_trainer(cfg)
+    _snapshot_config(cfg, trainer.log_dir)
     print(
         f"[train] {cfg.name}: M={cfg.num_formation} formations x "
         f"N={cfg.num_agents_per_formation} agents, "

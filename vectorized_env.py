@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Compatibility shim: the reference's training invocation, verbatim.
+
+The reference trains with ``python vectorized_env.py name=x``
+(reference README.md:18, vectorized_env.py:112-137). This repo's
+training entry point is ``train.py`` (same ``key=value`` CLI contract);
+this forwarder makes the reference's muscle-memory command work
+unchanged on the TPU-native backend.
+
+The reference module also *defines* ``FormationEnv(cfg)``
+(vectorized_env.py:16-109); importers get a same-signature construction
+over the host-side VecEnv adapter
+(marl_distributedformation_tpu/compat/vec_env.py).
+"""
+
+from marl_distributedformation_tpu.compat.vec_env import FormationVecEnv
+from marl_distributedformation_tpu.utils import env_params_from_config
+
+
+def __getattr__(name):
+    # Lazy so `import vectorized_env` for FormationEnv doesn't pull the
+    # whole training stack; `vectorized_env.main` still IS train.main.
+    if name == "main":
+        from train import main
+
+        return main
+    raise AttributeError(name)
+
+
+class FormationEnv(FormationVecEnv):
+    """Reference-signature constructor: takes the loaded config object
+    (reference vectorized_env.py:17 ``FormationEnv(cfg)``) instead of
+    explicit ``EnvParams``."""
+
+    def __init__(self, cfg):
+        super().__init__(
+            env_params_from_config(cfg),
+            num_formations=cfg.num_formation,
+            seed=int(cfg.get("seed", 0)),
+        )
+
+
+if __name__ == "__main__":
+    from train import main
+
+    main()

@@ -57,7 +57,15 @@ def main(argv=None) -> None:
             path = latest_checkpoint(checkpoint_dir / best)
             if path is not None:
                 print(f"sweep run: playing best member {best}")
-        elif members:
+            else:
+                # The summary's best_dir checkpoint is gone (e.g. deleted
+                # by hand) — fall through to the members scan below
+                # instead of claiming no checkpoint exists (ADVICE r3).
+                print(
+                    f"sweep summary points at {best} but it has no "
+                    "checkpoint; falling back to furthest-trained member"
+                )
+        if path is None and members:
             candidates = [
                 (latest_checkpoint(d), d.name) for d in members
             ]
@@ -67,8 +75,12 @@ def main(argv=None) -> None:
                     candidates,
                     key=lambda c: int(c[0].stem.split("_")[-2]),
                 )
+                why = (
+                    "best member missing" if summary.exists()
+                    else "no final summary (interrupted?)"
+                )
                 print(
-                    f"sweep run without a final summary (interrupted?): "
+                    f"sweep run, {why}: "
                     f"playing furthest-trained member {member}"
                 )
     if path is None:
