@@ -29,6 +29,14 @@ transform closure — one shared ``tx`` serves the whole population. These
 members' checkpoints carry params only (their opt_state tree differs from
 the single-run optimizer's; the resume path re-estimates Adam moments,
 same as SB3-imported checkpoints).
+
+Resume: ``resume=true`` restores the latest ``sweep_state_{steps}_steps``
+population checkpoint — the full batched learner state (params, optimizer
+moments AND injected per-member rates), member PRNG streams, env state,
+and progress — and continues bit-identically to an uninterrupted run
+(pinned by ``tests/test_sweep.py``). Operationally critical on hardware
+that can vanish mid-run for hours (the tunneled-TPU reality this repo
+benches on).
 """
 
 from __future__ import annotations
@@ -57,8 +65,11 @@ from marl_distributedformation_tpu.train.trainer import (
 from marl_distributedformation_tpu.utils import (
     MetricsLogger,
     Throughput,
+    latest_checkpoint,
+    latest_sweep_state,
     repo_root,
     save_checkpoint,
+    save_sweep_state,
 )
 
 Array = jax.Array
@@ -191,6 +202,14 @@ class SweepTrainer:
         # Host copy for checkpoint/summary provenance — reading the device
         # array per member would pay a round trip each (tunneled TPU).
         self._lrs_host = None if lrs is None else np.asarray(lrs)
+        self.num_timesteps = 0  # per-member agent-transitions (SB3 unit)
+        self.log_dir = config.log_dir or str(
+            repo_root() / "logs" / config.name
+        )
+        if config.resume:
+            # Restore BEFORE mesh placement so the resumed population is
+            # re-placed on the dp sharding exactly like a fresh one.
+            self._try_resume()
 
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -230,12 +249,8 @@ class SweepTrainer:
                 check_vma=False,
             )
         self._iteration = jax.jit(iteration_pop, donate_argnums=(0, 1))
-        self.num_timesteps = 0  # per-member agent-transitions (SB3 unit)
         self._vec_steps_since_save = 0
         self.num_envs = m * env_params.num_agents
-        self.log_dir = config.log_dir or str(
-            repo_root() / "logs" / config.name
-        )
 
     # ------------------------------------------------------------------
 
@@ -262,15 +277,18 @@ class SweepTrainer:
     def _host_population(self) -> Dict[str, Any]:
         """ONE batched device pull of everything checkpoints need — on a
         tunneled TPU, per-leaf-per-member transfers would pay K x leaves
-        round trips (the trainer-wide rule: sync once, slice on host)."""
-        pull = {"params": self.train_state.params, "key": self.key}
-        if not self._lr_sweep:
-            # lr-sweep members use the inject_hyperparams state tree, which
-            # the single-run optimizer can't restore into — omit it (the
-            # tolerant resume path re-estimates Adam moments, same as
-            # SB3-imported checkpoints).
-            pull["opt_state"] = self.train_state.opt_state
-        return jax.device_get(pull)
+        round trips (the trainer-wide rule: sync once, slice on host).
+        Both the per-member checkpoints and the population sweep_state
+        file are built from this single pull."""
+        return jax.device_get(
+            {
+                "params": self.train_state.params,
+                "opt_state": self.train_state.opt_state,
+                "key": self.key,
+                "env_state": self.env_state,
+                "obs": self.obs,
+            }
+        )
 
     def member_state(
         self, i: int, host: Optional[Dict[str, Any]] = None
@@ -300,14 +318,22 @@ class SweepTrainer:
                 else self.ppo.learning_rate
             ),
         }
-        if "opt_state" in host:
+        if not self._lr_sweep:
+            # lr-sweep members use the inject_hyperparams state tree, which
+            # the single-run optimizer can't restore into — omit it from
+            # MEMBER checkpoints (the tolerant resume path re-estimates
+            # Adam moments, same as SB3-imported checkpoints). The
+            # population sweep_state file keeps the full tree either way.
             state["opt_state"] = take(host["opt_state"])
         return state
 
     def save(self) -> None:
         """Per-member checkpoints under ``{log_dir}/seed{i}/`` — each one
         plays back / resumes through the standard single-run tooling
-        (``visualize_policy.py name={name}/seed{i}``)."""
+        (``visualize_policy.py name={name}/seed{i}``) — plus ONE
+        population-state file (``sweep_state_{steps}_steps.msgpack``)
+        carrying the full batched learner + env state, so an interrupted
+        sweep resumes exactly (``resume=true``) instead of restarting."""
         host = self._host_population()
         for i in range(self.num_seeds):
             save_checkpoint(
@@ -315,7 +341,120 @@ class SweepTrainer:
                 self.num_timesteps,
                 self.member_state(i, host),
             )
+        save_sweep_state(
+            self.log_dir, self.num_timesteps, self._population_target(host)
+        )
         self._vec_steps_since_save = 0
+
+    def _population_target(self, host: Dict[str, Any]) -> Dict[str, Any]:
+        """The full resume anchor: everything ``run_iteration`` threads,
+        batched over the (K,) seed axis — including the lr-sweep's
+        ``inject_hyperparams`` state, which member checkpoints must omit
+        (their tree differs from the single-run optimizer's) — plus the
+        identity fields resume validates against. Built from the
+        ``_host_population`` pull so a save costs ONE device round trip."""
+        target: Dict[str, Any] = {
+            "policy": self.model.__class__.__name__,
+            "num_seeds": self.num_seeds,
+            "seed": int(self.config.seed),
+            "num_formations": int(self.config.num_formations),
+            "num_timesteps": self.num_timesteps,
+            **host,
+        }
+        if self._lrs_host is not None:
+            target["learning_rates"] = self._lrs_host
+        return target
+
+    def _try_resume(self) -> None:
+        """Restore the latest ``sweep_state_*`` population checkpoint into
+        the freshly-initialized state. The restored run continues
+        bit-identically to an uninterrupted one (pinned by
+        tests/test_sweep.py): params, the batched optimizer state
+        (moments + per-member injected rates), member PRNG streams, env
+        state, and the step counter all come from the file."""
+        from flax import serialization
+
+        path = latest_sweep_state(self.log_dir)
+        if path is None:
+            if latest_checkpoint(Path(self.log_dir) / "seed0") is not None:
+                print(
+                    "[sweep] resume=true but no sweep_state_* population "
+                    f"checkpoint under {self.log_dir} (member checkpoints "
+                    "predate sweep resume or were written by an old "
+                    "version); starting fresh — resume individual members "
+                    "via their seed{i}/ dirs instead"
+                )
+            return
+        raw = serialization.msgpack_restore(Path(path).read_bytes())
+        ident = {
+            "policy": self.model.__class__.__name__,
+            "num_seeds": self.num_seeds,
+            "seed": int(self.config.seed),
+            # num_formations drifting silently would corrupt the timestep
+            # accounting (num_envs uses the NEW config while the restored
+            # env batch keeps the OLD M — batch dims are data-driven, so
+            # nothing else would catch it).
+            "num_formations": int(self.config.num_formations),
+        }
+        for field, want in ident.items():
+            got = raw.get(field)
+            if got != want and str(got) != str(want):
+                raise SystemExit(
+                    f"sweep resume mismatch: checkpoint {path} was written "
+                    f"with {field}={got!r} but this run uses {want!r} — "
+                    "member identities would silently change"
+                )
+        stored_lrs = raw.get("learning_rates")
+        if (stored_lrs is None) != (self._lrs_host is None):
+            raise SystemExit(
+                f"sweep resume mismatch: checkpoint {path} was written "
+                f"{'with' if stored_lrs is not None else 'without'} "
+                "learning_rates but this run is the opposite — the "
+                "optimizer state trees are incompatible; pass the same "
+                "learning_rates the sweep was started with"
+            )
+        if stored_lrs is not None:
+            stored_lrs = np.asarray(stored_lrs, np.float32)
+            if not np.allclose(stored_lrs, self._lrs_host, rtol=1e-6):
+                print(
+                    "[sweep] WARNING: checkpoint member learning rates "
+                    f"{stored_lrs.tolist()} differ from this run's "
+                    f"{self._lrs_host.tolist()} — continuing at the "
+                    "CHECKPOINT's rates (they live in the restored "
+                    "optimizer state)"
+                )
+            # Keep provenance truthful: member checkpoints record the rate
+            # actually used, which is the restored one.
+            self._lrs_host = stored_lrs
+            self.learning_rates = jnp.asarray(stored_lrs)
+        template = {
+            "params": self.train_state.params,
+            "opt_state": self.train_state.opt_state,
+            "key": self.key,
+            "env_state": self.env_state,
+            "obs": self.obs,
+        }
+        for name in (*template, "num_timesteps"):
+            if name not in raw:
+                raise SystemExit(
+                    f"sweep resume: checkpoint {path} is missing {name!r} "
+                    "— truncated or foreign file"
+                )
+        restored = {
+            name: serialization.from_state_dict(tmpl, raw[name])
+            for name, tmpl in template.items()
+        }
+        self.train_state = self.train_state.replace(
+            params=restored["params"], opt_state=restored["opt_state"]
+        )
+        self.key = jnp.asarray(restored["key"])
+        self.env_state = restored["env_state"]
+        self.obs = jnp.asarray(restored["obs"])
+        self.num_timesteps = int(raw["num_timesteps"])
+        print(
+            f"[sweep] resumed {self.num_seeds}-member population from "
+            f"{path} at {self.num_timesteps} steps"
+        )
 
     def train(self) -> Dict[str, float]:
         """Full sweep; logs population-aggregate metrics per rollout and

@@ -13,9 +13,12 @@ from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
     checkpoint_path,
     checkpoint_step,
     latest_checkpoint,
+    latest_sweep_state,
     restore_checkpoint,
     restore_checkpoint_partial,
     save_checkpoint,
+    save_sweep_state,
+    sweep_state_path,
 )
 from marl_distributedformation_tpu.utils.logging import MetricsLogger  # noqa: F401
 from marl_distributedformation_tpu.utils.profiling import (  # noqa: F401

@@ -21,10 +21,18 @@ from typing import Any, Optional
 from flax import serialization
 
 _STEP_RE = re.compile(r"rl_model_(\d+)_steps")
+# Population-sweep state files live beside member dirs under the sweep's
+# log_dir; the distinct prefix keeps them invisible to the rl_model_*
+# discovery scan (visualize_policy/member resume must never pick one up).
+_SWEEP_STEP_RE = re.compile(r"sweep_state_(\d+)_steps")
 
 
 def checkpoint_path(log_dir: str | Path, num_timesteps: int) -> Path:
     return Path(log_dir) / f"rl_model_{num_timesteps}_steps.msgpack"
+
+
+def sweep_state_path(log_dir: str | Path, num_timesteps: int) -> Path:
+    return Path(log_dir) / f"sweep_state_{num_timesteps}_steps.msgpack"
 
 
 def save_checkpoint(
@@ -51,17 +59,7 @@ def save_checkpoint(
     path = checkpoint_path(log_dir, num_timesteps)
     on_coordinator = is_coordinator()
     if on_coordinator:
-        path.parent.mkdir(parents=True, exist_ok=True)
-        # Dot-prefixed temp name so a torn write can never be picked up by
-        # latest_checkpoint (which also filters on the .msgpack suffix).
-        tmp = path.parent / f".{path.name}.tmp"
-        # Pull the whole tree in ONE batched transfer before serializing:
-        # to_bytes converts leaf-by-leaf, and on a tunneled TPU ~40 separate
-        # device->host round-trips can dominate the training loop (the
-        # reference-parity save_freq checkpoints every iteration).
-        target = jax.device_get(target)
-        tmp.write_bytes(serialization.to_bytes(target))
-        tmp.replace(path)  # atomic: no torn checkpoints (SURVEY.md §5)
+        _write_atomic(path, target)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -69,20 +67,55 @@ def save_checkpoint(
     return path if on_coordinator else None
 
 
-def latest_checkpoint(log_dir: str | Path) -> Optional[Path]:
-    """Find the checkpoint with the largest step number, exactly like the
-    reference's discovery scan (visualize_policy.py:29-32)."""
+def _write_atomic(path: Path, target: Any) -> None:
+    import jax
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    # Dot-prefixed temp name so a torn write can never be picked up by
+    # latest_checkpoint (which also filters on the .msgpack suffix).
+    tmp = path.parent / f".{path.name}.tmp"
+    # Pull the whole tree in ONE batched transfer before serializing:
+    # to_bytes converts leaf-by-leaf, and on a tunneled TPU ~40 separate
+    # device->host round-trips can dominate the training loop (the
+    # reference-parity save_freq checkpoints every iteration).
+    target = jax.device_get(target)
+    tmp.write_bytes(serialization.to_bytes(target))
+    tmp.replace(path)  # atomic: no torn checkpoints (SURVEY.md §5)
+
+
+def save_sweep_state(
+    log_dir: str | Path, num_timesteps: int, target: Any
+) -> Path:
+    """Write the full population state of a sweep (train/sweep.py) —
+    single-controller only (SweepTrainer asserts process_count == 1), so
+    no multi-host barrier."""
+    path = sweep_state_path(log_dir, num_timesteps)
+    _write_atomic(path, target)
+    return path
+
+
+def latest_sweep_state(log_dir: str | Path) -> Optional[Path]:
+    return _latest(log_dir, _SWEEP_STEP_RE)
+
+
+def _latest(log_dir: str | Path, step_re: re.Pattern) -> Optional[Path]:
     log_dir = Path(log_dir)
     if not log_dir.is_dir():
         return None
     candidates = [
         p
         for p in log_dir.iterdir()
-        if p.suffix == ".msgpack" and _STEP_RE.search(p.name)
+        if p.suffix == ".msgpack" and step_re.search(p.name)
     ]
     if not candidates:
         return None
-    return max(candidates, key=lambda p: int(_STEP_RE.search(p.name).group(1)))
+    return max(candidates, key=lambda p: int(step_re.search(p.name).group(1)))
+
+
+def latest_checkpoint(log_dir: str | Path) -> Optional[Path]:
+    """Find the checkpoint with the largest step number, exactly like the
+    reference's discovery scan (visualize_policy.py:29-32)."""
+    return _latest(log_dir, _STEP_RE)
 
 
 def restore_checkpoint(path: str | Path, template: Any) -> Any:

@@ -394,6 +394,105 @@ def test_sweep_composes_with_ctde_and_gnn(tmp_path):
     assert np.isfinite(np.asarray(m["loss"])).all()
 
 
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize(
+    "lr_sweep", [False, pytest.param(True, marks=pytest.mark.slow)]
+)
+def test_sweep_resume_bit_exact(tmp_path, lr_sweep):
+    """An interrupted sweep resumed from its sweep_state checkpoint ends
+    bit-identical to an uninterrupted run — params, optimizer state
+    (incl. per-member injected rates), member keys, env state, and
+    progress (VERDICT r3 #3)."""
+    params = EnvParams(num_agents=3)
+    lrs = [1e-3, 3e-3] if lr_sweep else None
+    per_iter = PPO.n_steps * 4 * 3  # n_steps * M * N agent-transitions
+    kw = dict(checkpoint=True, save_freq=10**9)
+
+    full = SweepTrainer(
+        params, ppo=PPO, num_seeds=2, learning_rates=lrs,
+        config=_cfg(tmp_path, name="full", log_dir=str(tmp_path / "full"),
+                    total_timesteps=2 * per_iter, **kw),
+    )
+    full.train()
+
+    half = SweepTrainer(
+        params, ppo=PPO, num_seeds=2, learning_rates=lrs,
+        config=_cfg(tmp_path, name="part", log_dir=str(tmp_path / "part"),
+                    total_timesteps=per_iter, **kw),
+    )
+    half.train()  # final save() writes sweep_state_{per_iter}_steps
+    assert (tmp_path / "part" /
+            f"sweep_state_{per_iter}_steps.msgpack").exists()
+
+    resumed = SweepTrainer(
+        params, ppo=PPO, num_seeds=2, learning_rates=lrs,
+        config=_cfg(tmp_path, name="part", log_dir=str(tmp_path / "part"),
+                    total_timesteps=2 * per_iter, resume=True, **kw),
+    )
+    assert resumed.num_timesteps == per_iter
+    resumed.train()
+
+    assert resumed.num_timesteps == full.num_timesteps
+    _leaves_equal(resumed.train_state.params, full.train_state.params)
+    _leaves_equal(resumed.train_state.opt_state, full.train_state.opt_state)
+    _leaves_equal(resumed.key, full.key)
+    _leaves_equal(resumed.env_state, full.env_state)
+    _leaves_equal(resumed.obs, full.obs)
+    # The resumed run's final ranking agrees with the uninterrupted one.
+    s_full = json.loads(
+        (tmp_path / "full" / "sweep_summary.json").read_text()
+    )
+    s_res = json.loads(
+        (tmp_path / "part" / "sweep_summary.json").read_text()
+    )
+    assert s_res["best_seed"] == s_full["best_seed"]
+    np.testing.assert_array_equal(
+        s_res["final_reward"], s_full["final_reward"]
+    )
+
+
+def test_sweep_resume_rejects_mismatches(tmp_path):
+    """Identity mismatches (population size, lr-sweep mode) must fail
+    loudly, not silently re-seed members."""
+    params = EnvParams(num_agents=3)
+    per_iter = PPO.n_steps * 4 * 3
+    cfg = _cfg(
+        tmp_path, name="pop", log_dir=str(tmp_path / "pop"),
+        checkpoint=True, save_freq=10**9, total_timesteps=per_iter,
+    )
+    SweepTrainer(params, ppo=PPO, num_seeds=2, config=cfg).train()
+
+    resume_cfg = _cfg(
+        tmp_path, name="pop", log_dir=str(tmp_path / "pop"),
+        checkpoint=True, save_freq=10**9, total_timesteps=2 * per_iter,
+        resume=True,
+    )
+    with pytest.raises(SystemExit, match="num_seeds"):
+        SweepTrainer(params, ppo=PPO, num_seeds=4, config=resume_cfg)
+    with pytest.raises(SystemExit, match="learning_rates"):
+        SweepTrainer(
+            params, ppo=PPO, num_seeds=2, config=resume_cfg,
+            learning_rates=[1e-3, 3e-3],
+        )
+
+    # Member checkpoints without a population file (pre-feature run):
+    # fresh start with a loud note, not a crash.
+    import os
+
+    os.remove(
+        tmp_path / "pop" / f"sweep_state_{per_iter}_steps.msgpack"
+    )
+    fresh = SweepTrainer(params, ppo=PPO, num_seeds=2, config=resume_cfg)
+    assert fresh.num_timesteps == 0
+
+
 @pytest.mark.slow
 def test_visualize_policy_auto_selects_best_member(
     tmp_path, monkeypatch, capsys
@@ -460,9 +559,13 @@ def test_cli_dispatch(tmp_path, monkeypatch):
     with pytest.raises(SystemExit, match="curriculum"):
         train_cli.build_trainer(cfg2)
 
+    # resume=true now composes with sweeps (population resume): with no
+    # prior sweep_state it just builds a fresh population.
+    monkeypatch.setattr(train_cli, "repo_root", lambda: tmp_path)
     cfg3 = load_config(
         ["name=x", "num_seeds=2", "resume=true", "platform=cpu",
-         "num_formation=4"]
+         "num_formation=4", "num_agents_per_formation=3"]
     )
-    with pytest.raises(SystemExit, match="resume"):
-        train_cli.build_trainer(cfg3)
+    trainer3 = train_cli.build_trainer(cfg3)
+    assert isinstance(trainer3, SweepTrainer)
+    assert trainer3.num_timesteps == 0

@@ -128,11 +128,6 @@ def build_trainer(cfg) -> Trainer:
     if num_seeds > 1:
         from marl_distributedformation_tpu.train import SweepTrainer
 
-        if train_cfg.resume:
-            raise SystemExit(
-                "num_seeds > 1 does not support resume=true; resume "
-                "individual members via their logs/{name}/seed{i}/ dirs"
-            )
         return SweepTrainer(
             env_params,
             ppo=ppo,
