@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Measured scaling curves for the dp/sweep sharded paths on a virtual
+CPU mesh (VERDICT r3 #7: numbers, not just green dryruns).
+
+No multi-chip hardware exists in this environment, so each device count
+D in 1..8 runs in a subprocess with
+``--xla_force_host_platform_device_count=D`` — the same virtual mesh the
+test suite and the driver's ``dryrun_multichip`` use. What this CAN
+measure honestly: that the sharded programs execute at every D and what
+the partitioner/collective machinery costs on top of the same total
+work. What it CANNOT measure: real weak scaling — all D virtual devices
+share this host's CPU cores (2 here), so past D=cores the devices
+serialize and wall-clock grows with total work by construction. The doc
+table (docs/weak_scaling.md) therefore reports:
+
+- ``dp_env`` / ``dp_train`` (fixed TOTAL load): sharding the same work
+  over more virtual devices. Ideal is flat; growth above the D=1 row is
+  partitioning/collective overhead (the psum gradient all-reduce in
+  dp_train), which IS the transferable number.
+- ``sweep`` (fixed PER-DEVICE load, one member per device): total work
+  grows with D. On shared cores the serialization bound is
+  time >= t1 * D / min(D, cores); the table reports measured
+  member-iterations/s and that bound so overhead is visible as the gap.
+
+Usage: python scripts/weak_scaling.py            # parent: all D, writes doc
+       python scripts/weak_scaling.py --child D  # one D, prints JSON lines
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+DEVICE_COUNTS = tuple(
+    int(d)
+    for d in os.environ.get("WS_DEVICES", "1,2,4,8").split(",")
+)
+M_TOTAL = _env_int("WS_M_TOTAL", 256)  # fixed-total formations for dp_env
+M_TRAIN = _env_int("WS_M_TRAIN", 64)  # fixed-total formations for dp_train
+M_PER_MEMBER = _env_int("WS_M_MEMBER", 32)  # per-device load, sweep phase
+N_AGENTS = 5
+ENV_CHUNK = _env_int("WS_ENV_CHUNK", 64)  # env steps per timed dispatch
+MIN_TIMED_S = float(os.environ.get("WS_MIN_TIMED_S", 2.0))
+
+
+def _time_calls(fn, *args):
+    """Warm up TWICE, then average over >= MIN_TIMED_S of calls.
+
+    Two warmups, not one: the trainer paths recompile on their second
+    call (the first execution's donated outputs carry the compiled
+    program's shardings, which differ from the host-placed init — the
+    retrace is once-only). Timing after a single warmup measures that
+    second compile, not the steady state."""
+    import jax
+
+    for _ in range(2):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    calls, start = 0, time.perf_counter()
+    while time.perf_counter() - start < MIN_TIMED_S:
+        out = fn(*args)
+        jax.block_until_ready(out)
+        calls += 1
+    return (time.perf_counter() - start) / calls
+
+
+def child(n_dev: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) == n_dev, (
+        f"expected {n_dev} virtual devices, got {len(jax.devices())} — "
+        "XLA_FLAGS must be set before backend init"
+    )
+    import jax.numpy as jnp
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.env.formation import reset_batch
+    from marl_distributedformation_tpu.parallel import (
+        make_dp_step,
+        make_mesh,
+        make_shard_fn,
+        shard_batch,
+    )
+    from marl_distributedformation_tpu.train import (
+        SweepTrainer,
+        TrainConfig,
+        Trainer,
+    )
+
+    params = EnvParams(num_agents=N_AGENTS)
+    mesh = make_mesh({"dp": n_dev})
+    ppo = PPOConfig(n_steps=4, batch_size=8 * M_TRAIN, n_epochs=2)
+
+    def emit(phase: str, seconds: float, work_steps: float) -> None:
+        print(
+            json.dumps(
+                {
+                    "phase": phase,
+                    "devices": n_dev,
+                    "seconds_per_call": seconds,
+                    "steps_per_sec": work_steps / seconds,
+                }
+            ),
+            flush=True,
+        )
+
+    # -- dp_env: fixed-total env stepping, shard_map over 'dp' ----------
+    dp_step = make_dp_step(params, mesh)
+    state = shard_batch(reset_batch(jax.random.PRNGKey(0), params, M_TOTAL),
+                        mesh)
+    vel = shard_batch(
+        jnp.zeros((M_TOTAL, N_AGENTS, 2), jnp.float32) + 1.0, mesh
+    )
+
+    @jax.jit
+    def run_chunk(state, vel):
+        def body(s, _):
+            s, tr = dp_step(s, vel)
+            return s, tr.reward.mean()
+
+        return jax.lax.scan(body, state, None, length=ENV_CHUNK)
+
+    emit("dp_env", _time_calls(run_chunk, state, vel),
+         M_TOTAL * ENV_CHUNK)
+
+    # -- dp_train: fixed-total full PPO iteration (psum grad all-reduce) -
+    trainer = Trainer(
+        params,
+        ppo=ppo,
+        config=TrainConfig(
+            num_formations=M_TRAIN, name="ws", checkpoint=False,
+            log_dir="/tmp/ws_train",
+        ),
+        shard_fn=make_shard_fn(mesh=mesh),
+    )
+    emit("dp_train", _time_calls(trainer.run_iteration),
+         ppo.n_steps * M_TRAIN)
+
+    # -- sweep: one member per device, fixed per-device load -------------
+    sweep = SweepTrainer(
+        params,
+        ppo=PPOConfig(n_steps=4, batch_size=8 * M_PER_MEMBER, n_epochs=2),
+        config=TrainConfig(
+            num_formations=M_PER_MEMBER, name="ws", checkpoint=False,
+            log_dir="/tmp/ws_sweep",
+        ),
+        num_seeds=n_dev,
+        mesh=mesh,
+    )
+    emit("sweep", _time_calls(sweep.run_iteration),
+         4 * M_PER_MEMBER * n_dev)
+
+
+def parent() -> None:
+    rows = []
+    for n_dev in DEVICE_COUNTS:
+        env = dict(
+            os.environ,
+            XLA_FLAGS=(
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n_dev}"
+            ),
+        )
+        print(f"[weak_scaling] D={n_dev} ...", file=sys.stderr, flush=True)
+        out = subprocess.run(
+            [sys.executable, __file__, "--child", str(n_dev)],
+            env=env,
+            capture_output=True,
+            text=True,
+            check=False,
+        )
+        if out.returncode != 0:
+            print(out.stdout, file=sys.stderr)
+            print(out.stderr, file=sys.stderr)
+            raise SystemExit(f"child D={n_dev} failed")
+        for line in out.stdout.splitlines():
+            if line.startswith("{"):
+                rows.append(json.loads(line))
+    write_doc(rows)
+    print(json.dumps(rows, indent=2))
+
+
+def write_doc(rows) -> None:
+    import multiprocessing
+
+    cores = multiprocessing.cpu_count()
+    by_phase: dict = {}
+    for r in rows:
+        by_phase.setdefault(r["phase"], {})[r["devices"]] = r
+
+    lines = [
+        "# Sharded-path scaling on the virtual CPU mesh",
+        "",
+        "Measured by `scripts/weak_scaling.py` (subprocess per device",
+        "count, `--xla_force_host_platform_device_count=D`, CPU backend,",
+        f"{cores} host cores). **These numbers bound the partitioner +",
+        "collective overhead of the sharded programs — they are NOT",
+        "multi-chip performance**: all D virtual devices share the same",
+        "host cores, so past D=cores the devices serialize by",
+        "construction. On real chips the dp/sweep programs have zero or",
+        "one collective (see parallel/), so the transferable signal is",
+        "the overhead column staying small.",
+        "",
+    ]
+    captions = {
+        "dp_env": (
+            f"## dp_env — fixed total load ({M_TOTAL} formations, "
+            "shard_map env step)\n\nIdeal: flat. Overhead = slowdown vs "
+            "D=1 for identical total work."
+        ),
+        "dp_train": (
+            f"## dp_train — fixed total load ({M_TRAIN} formations, full "
+            "PPO iteration incl. psum gradient all-reduce)\n\nIdeal: "
+            "flat. This is the collective-bearing path. Note the "
+            f"per-device slice shrinks to {M_TRAIN} / D formations, so at "
+            "D=8 the fixed per-device dispatch + emulated-collective cost "
+            "dominates a tiny compute slice — on real chips the same "
+            "program runs thousands of formations per device and the "
+            "psum rides ICI."
+        ),
+        "sweep": (
+            f"## sweep — fixed per-device load (1 member x {M_PER_MEMBER} "
+            "formations per device)\n\nTotal work grows with D; the "
+            "serialization bound on shared cores is t >= t1 * D / "
+            "min(D, cores). Overhead = slowdown vs that bound."
+        ),
+    }
+    for phase in ("dp_env", "dp_train", "sweep"):
+        data = by_phase.get(phase)
+        if not data:
+            continue
+        t1 = data[1]["seconds_per_call"]
+        lines += [captions[phase], "",
+                  "| D | s/call | steps/s | overhead |", "|---|---|---|---|"]
+        for d in sorted(data):
+            r = data[d]
+            if phase == "sweep":
+                bound = t1 * d / min(d, cores)
+            else:
+                bound = t1
+            over = r["seconds_per_call"] / bound - 1.0
+            lines.append(
+                f"| {d} | {r['seconds_per_call']:.3f} | "
+                f"{r['steps_per_sec']:,.0f} | {over:+.1%} |"
+            )
+        lines.append("")
+    doc = Path(
+        os.environ.get(
+            "WS_DOC",
+            Path(__file__).resolve().parent.parent
+            / "docs" / "weak_scaling.md",
+        )
+    )
+    doc.write_text("\n".join(lines))
+    print(f"[weak_scaling] wrote {doc}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]))
+    else:
+        parent()

@@ -344,3 +344,43 @@ def test_gnn_trains_on_sp_mesh(tmp_path):
     )
     assert trainer._env_step_fn is not None
     assert np.isfinite(trainer.run_iteration()["loss"])
+
+
+@pytest.mark.slow
+def test_weak_scaling_script_smoke(tmp_path, monkeypatch):
+    """scripts/weak_scaling.py end-to-end at tiny sizes: every phase
+    emits a row per device count and the doc table is written."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(
+        os.environ,
+        WS_DEVICES="1,2",
+        WS_M_TOTAL="8",
+        WS_M_TRAIN="8",
+        WS_M_MEMBER="4",
+        WS_ENV_CHUNK="4",
+        WS_MIN_TIMED_S="0.1",
+        WS_DOC=str(tmp_path / "weak_scaling.md"),
+    )
+    out = subprocess.run(
+        [_sys.executable, str(repo / "scripts" / "weak_scaling.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = json.loads(out.stdout)
+    got = {(r["phase"], r["devices"]) for r in rows}
+    assert got == {
+        (p, d) for p in ("dp_env", "dp_train", "sweep") for d in (1, 2)
+    }
+    assert all(r["steps_per_sec"] > 0 for r in rows)
+    doc = (tmp_path / "weak_scaling.md").read_text()
+    assert "| 2 |" in doc and "sweep" in doc
