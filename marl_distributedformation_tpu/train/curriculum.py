@@ -162,6 +162,17 @@ class HeteroTrainer:
         )
         self.ppo = ppo
         self.config = config
+        if int(config.iters_per_dispatch) > 1:
+            # Stage boundaries are host-driven (count resampling + env
+            # reset between stages); fusing iterations across them would
+            # silently blur the curriculum, and fusing within a stage
+            # would need stage-length-aware burst sizing. Reject loudly
+            # instead of silently running at cadence 1.
+            raise SystemExit(
+                "iters_per_dispatch > 1 does not compose with curriculum "
+                "training (stage boundaries are host-driven); unset it or "
+                "drop the curriculum"
+            )
 
         self.model = model or MLPActorCritic(
             act_dim=self.env_params.act_dim, log_std_init=ppo.log_std_init

@@ -59,6 +59,7 @@ from marl_distributedformation_tpu.env.formation import (
 from marl_distributedformation_tpu.models import MLPActorCritic
 from marl_distributedformation_tpu.train.trainer import (
     TrainConfig,
+    _burst,
     default_total_timesteps,
     make_ppo_iteration,
 )
@@ -248,6 +249,12 @@ class SweepTrainer:
                 # parallel/mesh.py).
                 check_vma=False,
             )
+        self._iters_per_dispatch = max(1, int(config.iters_per_dispatch))
+        if self._iters_per_dispatch > 1:
+            # Scan-fuse R population iterations per dispatch, same as the
+            # single-run trainer (the burst reductions are axis-0 over the
+            # scan, so the (K,) member axis passes through untouched).
+            iteration_pop = _burst(iteration_pop, self._iters_per_dispatch)
         self._iteration = jax.jit(iteration_pop, donate_argnums=(0, 1))
         self._vec_steps_since_save = 0
         self.num_envs = m * env_params.num_agents
@@ -270,8 +277,9 @@ class SweepTrainer:
         ) = self._iteration(
             self.train_state, self.env_state, self.obs, self.key
         )
-        self.num_timesteps += self.ppo.n_steps * self.num_envs
-        self._vec_steps_since_save += self.ppo.n_steps
+        r = self._iters_per_dispatch
+        self.num_timesteps += r * self.ppo.n_steps * self.num_envs
+        self._vec_steps_since_save += r * self.ppo.n_steps
         return metrics
 
     def _host_population(self) -> Dict[str, Any]:
@@ -475,7 +483,8 @@ class SweepTrainer:
                 metrics = self.run_iteration()
                 iteration += 1
                 meter.tick(
-                    self.ppo.n_steps
+                    self._iters_per_dispatch
+                    * self.ppo.n_steps
                     * self.config.num_formations
                     * self.num_seeds
                 )

@@ -55,6 +55,10 @@ python scripts/tpu_profile_breakdown.py 4096
 echo "== population sweep amortization (K=8) =="
 python scripts/tpu_sweep_bench.py 8 512
 
+echo "== big-batch training tuning (16k/32k with lr scaling + eval guard) =="
+python scripts/tpu_train_tuning.py 4096 120 | tail -1 > /tmp/train_tuning.json
+cat /tmp/train_tuning.json
+
 echo "== full bench =="
 python bench.py | tail -1 > /tmp/bench_tpu.json
 cat /tmp/bench_tpu.json

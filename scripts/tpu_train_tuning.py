@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Chip experiment: push tuned training past batch 8192 with a learning-
+quality guard (VERDICT r3 #6).
+
+Sweeps (batch_size, learning_rate) points at M=4096 — the preset=tpu
+point plus 16k/32k batches with sqrt-scaled rates (Krizhevsky-style: lr
+x sqrt(batch/base) keeps per-sample gradient noise comparable) — trains
+each for the same agent-transition budget, then evaluates the result on
+held-out initial states against the scripted baseline and zero actions
+(marl_distributedformation_tpu/eval.py). A point only counts as a
+throughput win if its evaluation reward still beats the baseline by at
+least GUARD x the preset point's margin — faster-but-dumber batches are
+flagged, not crowned.
+
+Usage (chip window; CPU works for a self-smoke at tiny sizes):
+    python scripts/tpu_train_tuning.py [M] [iters]
+    TUNE_POINTS="8192:1e-3,16384:1.4e-3" python scripts/tpu_train_tuning.py
+
+Prints a table + one JSON line; mirror into docs/profiling.md when run
+on hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+GUARD = 0.9  # eval margin-over-baseline must stay within 10% of preset's
+
+
+def default_points():
+    # lr scaling: sqrt(batch / 8192) on the preset rate 1e-3 — plus an
+    # unscaled control per batch so the lr effect is separable.
+    return [
+        (8192, 1.0e-3),
+        (16384, 1.0e-3),
+        (16384, 1.4e-3),
+        (32768, 1.0e-3),
+        (32768, 2.0e-3),
+    ]
+
+
+def parse_points(spec: str):
+    return [
+        (int(b), float(lr))
+        for b, lr in (p.split(":") for p in spec.split(","))
+    ]
+
+
+def main() -> None:
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 120
+    points = (
+        parse_points(os.environ["TUNE_POINTS"])
+        if "TUNE_POINTS" in os.environ
+        else default_points()
+    )
+
+    import jax
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.eval import (
+        baseline_act_fn,
+        evaluate,
+        policy_act_fn,
+        zero_act_fn,
+    )
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+
+    params = EnvParams(num_agents=5)
+    eval_m = min(1024, max(64, m // 4))
+    base = evaluate(baseline_act_fn(params), params, eval_m)
+    zero = evaluate(zero_act_fn(), params, eval_m)
+    print(
+        f"[tune] eval anchors (M={eval_m}): baseline return "
+        f"{base['episode_return_per_agent']:.2f}, "
+        f"zero {zero['episode_return_per_agent']:.2f}",
+        file=sys.stderr,
+    )
+
+    rows = []
+    for batch, lr in points:
+        ppo = PPOConfig(batch_size=batch, learning_rate=lr)
+        trainer = Trainer(
+            params,
+            ppo=ppo,
+            config=TrainConfig(
+                num_formations=m, checkpoint=False, use_wandb=False,
+                name="tune",
+            ),
+        )
+        for _ in range(2):  # compile (+ the donated-shardings retrace)
+            metrics = trainer.run_iteration()
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            metrics = trainer.run_iteration()
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        rate = iters * ppo.n_steps * m / dt
+
+        act = policy_act_fn(
+            trainer.model, trainer.train_state.params, params
+        )
+        ev = evaluate(act, params, eval_m)
+        margin = ev["episode_return_per_agent"] - base["episode_return_per_agent"]
+        rows.append(
+            {
+                "batch_size": batch,
+                "learning_rate": lr,
+                "train_steps_per_sec": round(rate, 1),
+                "eval_return": round(ev["episode_return_per_agent"], 3),
+                "margin_vs_baseline": round(margin, 3),
+            }
+        )
+        print(
+            f"[tune] batch={batch} lr={lr:g}: {rate:,.0f} "
+            f"formation-steps/s, eval return {ev['episode_return_per_agent']:.2f} "
+            f"(baseline {base['episode_return_per_agent']:.2f})",
+            file=sys.stderr,
+        )
+
+    preset_margin = rows[0]["margin_vs_baseline"]
+    for r in rows:
+        # Rewards are negative-cost shaped; "keeps quality" = margin not
+        # materially below the preset point's.
+        r["quality_ok"] = bool(
+            r["margin_vs_baseline"]
+            >= preset_margin - abs(preset_margin) * (1 - GUARD)
+        )
+    ok = [r for r in rows if r["quality_ok"]]
+    best = max(ok, key=lambda r: r["train_steps_per_sec"]) if ok else None
+    out = {
+        "m": m,
+        "iters_per_point": iters,
+        "eval_m": eval_m,
+        "baseline_return": round(base["episode_return_per_agent"], 3),
+        "zero_return": round(zero["episode_return_per_agent"], 3),
+        "device": jax.devices()[0].device_kind,
+        "points": rows,
+        "best_quality_ok": best,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

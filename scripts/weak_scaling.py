@@ -241,13 +241,17 @@ def write_doc(rows) -> None:
         data = by_phase.get(phase)
         if not data:
             continue
-        t1 = data[1]["seconds_per_call"]
+        # Baseline = smallest measured D (WS_DEVICES may omit 1).
+        d_base = min(data)
+        t1 = data[d_base]["seconds_per_call"]
         lines += [captions[phase], "",
                   "| D | s/call | steps/s | overhead |", "|---|---|---|---|"]
         for d in sorted(data):
             r = data[d]
             if phase == "sweep":
-                bound = t1 * d / min(d, cores)
+                # Serialization bound normalized to the baseline D.
+                serial = lambda k: k / min(k, cores)  # noqa: E731
+                bound = t1 * serial(d) / serial(d_base)
             else:
                 bound = t1
             over = r["seconds_per_call"] / bound - 1.0

@@ -50,6 +50,8 @@ def test_bench_emits_parseable_json_on_cpu(monkeypatch, capsys):
     assert rec["train_env_steps_per_sec"] > 0
     assert rec["knn_env_steps_per_sec"] > 0
     assert rec["knn_big_env_steps_per_sec"] > 0  # phase 4 emits too
+    assert rec["train_env_steps_per_sec_tuned_fused"] > 0
+    assert rec["train_tuned_iters_per_dispatch"] >= 2
     assert "error" not in rec and "notes" not in rec
     # Provenance pin (VERDICT.md r3 weak #5): the parity field replays a
     # committed chip artifact, so it must carry the artifact's recorded

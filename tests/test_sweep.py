@@ -394,6 +394,39 @@ def test_sweep_composes_with_ctde_and_gnn(tmp_path):
     assert np.isfinite(np.asarray(m["loss"])).all()
 
 
+def test_sweep_iters_per_dispatch_matches_single(tmp_path):
+    """The scan-fused dispatch (iters_per_dispatch=2) advances the
+    population like two single dispatches; curriculum rejects the knob."""
+    params = EnvParams(num_agents=3)
+    single = SweepTrainer(
+        params, ppo=PPO, config=_cfg(tmp_path), num_seeds=2
+    )
+    burst = SweepTrainer(
+        params, ppo=PPO, config=_cfg(tmp_path, iters_per_dispatch=2),
+        num_seeds=2,
+    )
+    m0 = single.run_iteration()
+    m1 = single.run_iteration()
+    mb = burst.run_iteration()
+    assert single.num_timesteps == burst.num_timesteps
+    _leaves_allclose(single.train_state.params, burst.train_state.params)
+    np.testing.assert_allclose(
+        np.asarray(mb["reward"]),
+        (np.asarray(m0["reward"]) + np.asarray(m1["reward"])) / 2,
+        rtol=1e-5,
+    )
+    assert mb["reward"].shape == (2,)  # member axis survives the burst
+
+    from marl_distributedformation_tpu.train import HeteroTrainer
+
+    with pytest.raises(SystemExit, match="iters_per_dispatch"):
+        HeteroTrainer(
+            env_params=params,
+            ppo=PPO,
+            config=_cfg(tmp_path, iters_per_dispatch=2),
+        )
+
+
 def _leaves_equal(a, b):
     la = jax.tree_util.tree_leaves(a)
     lb = jax.tree_util.tree_leaves(b)

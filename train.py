@@ -56,6 +56,7 @@ def train_config_from_config(cfg) -> TrainConfig:
         resume=cfg.get("resume", False),
         log_interval=cfg.log_interval,
         profile=bool(cfg.get("profile", False)),
+        iters_per_dispatch=int(cfg.get("iters_per_dispatch", 1)),
     )
 
 
