@@ -14,7 +14,12 @@ XLA inserts ZERO collectives and each chip trains ``K/D`` members. This
 turns one chip's tuned 4096-formation throughput into a multi-chip
 hyperparameter/seed search with perfect scaling, which is the idiomatic
 TPU answer to "train many policies": no multiprocessing, no per-process
-checkpoints to reconcile, one metrics stream.
+checkpoints to reconcile, one metrics stream. Multi-host (round 4):
+every process initializes only its own member block (per-host
+construction, the ``parallel.global_from_local`` pattern), the training
+step runs SPMD over the global mesh, and checkpoint IO allgathers the
+population to the coordinator — pinned by a real two-process test
+(tests/test_multiprocess.py).
 
 Seed semantics: member ``i`` uses root key ``PRNGKey(config.seed + i)``
 — bit-identical to a single :class:`Trainer` constructed with
@@ -103,12 +108,21 @@ class SweepTrainer:
         learning_rates: Any = None,
     ) -> None:
         assert num_seeds >= 1
-        assert jax.process_count() == 1, (
-            "SweepTrainer is single-controller: multi-host sweeps would "
-            "need per-host population construction (parallel/distributed "
-            "covers the single-run path); shard the seed axis over local "
-            "devices via mesh= instead"
-        )
+        self._multihost = jax.process_count() > 1
+        if self._multihost:
+            # Multi-host sweeps: every process initializes ONLY its own
+            # members (per-host construction, parallel/distributed.py
+            # style), the seed axis is globally 'dp'-sharded, and
+            # checkpoint IO allgathers to the coordinator. Requires a
+            # mesh spanning every global device.
+            assert mesh is not None, (
+                "multi-host sweeps need a global mesh (cfg mesh={dp: -1})"
+            )
+            assert num_seeds % jax.process_count() == 0, (
+                f"num_seeds={num_seeds} must be divisible by "
+                f"process_count={jax.process_count()} (even per-host "
+                "member construction)"
+            )
         self.env_params = env_params
         self.ppo = ppo
         self.config = config
@@ -193,12 +207,35 @@ class SweepTrainer:
 
         seeds = config.seed + jnp.arange(num_seeds)
         init_args = (seeds,) if lrs is None else (seeds, lrs)
-        (
-            self.train_state,
-            self.env_state,
-            self.obs,
-            self.key,
-        ) = jax.jit(jax.vmap(init_member))(*init_args)
+        if self._multihost:
+            # Per-host construction: this process initializes ONLY its own
+            # contiguous member block and the population is assembled as
+            # globally 'dp'-sharded arrays (mirrors
+            # parallel.reset_batch_sharded — required for correctness:
+            # cross-process device_put of host-global arrays is
+            # impossible). Checkpoint IO does transiently allgather the
+            # population to every host (see _to_host).
+            from marl_distributedformation_tpu.parallel import (
+                global_from_local,
+            )
+
+            start, count = self._member_slice()
+            local = jax.jit(jax.vmap(init_member))(
+                *(a[start : start + count] for a in init_args)
+            )
+            (
+                self.train_state,
+                self.env_state,
+                self.obs,
+                self.key,
+            ) = global_from_local(jax.device_get(local), mesh)
+        else:
+            (
+                self.train_state,
+                self.env_state,
+                self.obs,
+                self.key,
+            ) = jax.jit(jax.vmap(init_member))(*init_args)
         self.learning_rates = lrs
         # Host copy for checkpoint/summary provenance — reading the device
         # array per member would pay a round trip each (tunneled TPU).
@@ -212,7 +249,9 @@ class SweepTrainer:
             # re-placed on the dp sharding exactly like a fresh one.
             self._try_resume()
 
-        if mesh is not None:
+        if mesh is not None and not self._multihost:
+            # Multi-host state is already globally placed by
+            # global_from_local (cross-host device_put is impossible).
             from jax.sharding import NamedSharding, PartitionSpec
 
             shard = NamedSharding(mesh, PartitionSpec("dp"))
@@ -261,6 +300,29 @@ class SweepTrainer:
 
     # ------------------------------------------------------------------
 
+    def _member_slice(self):
+        """``(start, count)`` of this process's contiguous member block —
+        the seed-axis analog of ``parallel.local_formation_slice``."""
+        n_proc = jax.process_count()
+        count = self.num_seeds // n_proc
+        return jax.process_index() * count, count
+
+    def _to_host(self, tree):
+        """Full host copy of a (possibly cross-host-sharded) tree: plain
+        ``device_get`` single-controller, allgather multi-host (the
+        coordinator needs every member for checkpoints/summaries;
+        multihost_utils has no coordinator-only gather, so every host
+        transiently holds the full population — fine at this env's state
+        sizes: K members x M formations of 2-D agent positions is MBs,
+        not the multi-GB regime where a p2p path would be warranted)."""
+        if not self._multihost:
+            return jax.device_get(tree)
+        from jax.experimental import multihost_utils
+
+        return jax.tree_util.tree_map(
+            np.asarray, multihost_utils.process_allgather(tree, tiled=True)
+        )
+
     @property
     def total_timesteps(self) -> int:
         return default_total_timesteps(self.config)
@@ -288,7 +350,7 @@ class SweepTrainer:
         round trips (the trainer-wide rule: sync once, slice on host).
         Both the per-member checkpoints and the population sweep_state
         file are built from this single pull."""
-        return jax.device_get(
+        return self._to_host(
             {
                 "params": self.train_state.params,
                 "opt_state": self.train_state.opt_state,
@@ -342,12 +404,20 @@ class SweepTrainer:
         population-state file (``sweep_state_{steps}_steps.msgpack``)
         carrying the full batched learner + env state, so an interrupted
         sweep resumes exactly (``resume=true``) instead of restarting."""
+        from marl_distributedformation_tpu.parallel import is_coordinator
+
         host = self._host_population()
+        on_coord = is_coordinator()
         for i in range(self.num_seeds):
+            # Non-coordinators skip both the member-state slicing (K
+            # owning copies nobody would write) and the per-file barrier;
+            # the single synced sweep_state write below is the durability
+            # point for the whole logical checkpoint.
             save_checkpoint(
                 Path(self.log_dir) / f"seed{i}",
                 self.num_timesteps,
-                self.member_state(i, host),
+                self.member_state(i, host) if on_coord else None,
+                sync=False,
             )
         save_sweep_state(
             self.log_dir, self.num_timesteps, self._population_target(host)
@@ -380,19 +450,58 @@ class SweepTrainer:
         tests/test_sweep.py): params, the batched optimizer state
         (moments + per-member injected rates), member PRNG streams, env
         state, and the step counter all come from the file."""
-        from flax import serialization
-
+        if self._multihost:
+            self._try_resume_multihost()
+            return
         path = latest_sweep_state(self.log_dir)
         if path is None:
-            if latest_checkpoint(Path(self.log_dir) / "seed0") is not None:
-                print(
-                    "[sweep] resume=true but no sweep_state_* population "
-                    f"checkpoint under {self.log_dir} (member checkpoints "
-                    "predate sweep resume or were written by an old "
-                    "version); starting fresh — resume individual members "
-                    "via their seed{i}/ dirs instead"
-                )
+            self._note_no_population_file()
             return
+        restored, steps, stored_lrs = self._read_population_file(path)
+        self._adopt_checkpoint_lrs(stored_lrs)
+        self.train_state = self.train_state.replace(
+            params=restored["params"], opt_state=restored["opt_state"]
+        )
+        self.key = jnp.asarray(restored["key"])
+        self.env_state = restored["env_state"]
+        self.obs = jnp.asarray(restored["obs"])
+        self.num_timesteps = steps
+        print(
+            f"[sweep] resumed {self.num_seeds}-member population from "
+            f"{path} at {self.num_timesteps} steps"
+        )
+
+    def _note_no_population_file(self) -> None:
+        if latest_checkpoint(Path(self.log_dir) / "seed0") is not None:
+            print(
+                "[sweep] resume=true but no sweep_state_* population "
+                f"checkpoint under {self.log_dir} (member checkpoints "
+                "predate sweep resume or were written by an old "
+                "version); starting fresh — resume individual members "
+                "via their seed{i}/ dirs instead"
+            )
+
+    def _host_template(self) -> Dict[str, Any]:
+        """Host-zero template with the GLOBAL population shapes — usable
+        on every process even when the live state is cross-host-sharded
+        (shape/dtype are known without addressability)."""
+        template = {
+            "params": self.train_state.params,
+            "opt_state": self.train_state.opt_state,
+            "key": self.key,
+            "env_state": self.env_state,
+            "obs": self.obs,
+        }
+        return jax.tree_util.tree_map(
+            lambda x: np.zeros(x.shape, x.dtype), template
+        )
+
+    def _read_population_file(self, path):
+        """Parse + validate a sweep_state file; returns
+        ``(restored_host_tree, num_timesteps, stored_lrs)``. Raises
+        SystemExit on any identity/compatibility mismatch."""
+        from flax import serialization
+
         raw = serialization.msgpack_restore(Path(path).read_bytes())
         ident = {
             "policy": self.model.__class__.__name__,
@@ -423,25 +532,7 @@ class SweepTrainer:
             )
         if stored_lrs is not None:
             stored_lrs = np.asarray(stored_lrs, np.float32)
-            if not np.allclose(stored_lrs, self._lrs_host, rtol=1e-6):
-                print(
-                    "[sweep] WARNING: checkpoint member learning rates "
-                    f"{stored_lrs.tolist()} differ from this run's "
-                    f"{self._lrs_host.tolist()} — continuing at the "
-                    "CHECKPOINT's rates (they live in the restored "
-                    "optimizer state)"
-                )
-            # Keep provenance truthful: member checkpoints record the rate
-            # actually used, which is the restored one.
-            self._lrs_host = stored_lrs
-            self.learning_rates = jnp.asarray(stored_lrs)
-        template = {
-            "params": self.train_state.params,
-            "opt_state": self.train_state.opt_state,
-            "key": self.key,
-            "env_state": self.env_state,
-            "obs": self.obs,
-        }
+        template = self._host_template()
         for name in (*template, "num_timesteps"):
             if name not in raw:
                 raise SystemExit(
@@ -452,16 +543,85 @@ class SweepTrainer:
             name: serialization.from_state_dict(tmpl, raw[name])
             for name, tmpl in template.items()
         }
-        self.train_state = self.train_state.replace(
-            params=restored["params"], opt_state=restored["opt_state"]
+        return restored, int(raw["num_timesteps"]), stored_lrs
+
+    def _adopt_checkpoint_lrs(self, stored_lrs) -> None:
+        if stored_lrs is None:
+            return
+        if not np.allclose(stored_lrs, self._lrs_host, rtol=1e-6):
+            print(
+                "[sweep] WARNING: checkpoint member learning rates "
+                f"{stored_lrs.tolist()} differ from this run's "
+                f"{self._lrs_host.tolist()} — continuing at the "
+                "CHECKPOINT's rates (they live in the restored "
+                "optimizer state)"
+            )
+        # Keep provenance truthful: member checkpoints record the rate
+        # actually used, which is the restored one.
+        self._lrs_host = stored_lrs
+        self.learning_rates = jnp.asarray(stored_lrs)
+
+    def _try_resume_multihost(self) -> None:
+        """Multi-host population resume: the coordinator reads + validates
+        the file, every host receives the identical host state, slices its
+        own member block, and re-places it globally — mirroring
+        ``utils.broadcast_restore``'s fail-fast protocol (on a coordinator
+        error peers are released with found=0 BEFORE the error re-raises,
+        so nobody blocks inside the broadcast)."""
+        from jax.experimental import multihost_utils
+
+        from marl_distributedformation_tpu.parallel import (
+            global_from_local,
+            is_coordinator,
         )
-        self.key = jnp.asarray(restored["key"])
-        self.env_state = restored["env_state"]
-        self.obs = jnp.asarray(restored["obs"])
-        self.num_timesteps = int(raw["num_timesteps"])
+
+        template = self._host_template()
+        restored, steps, found, err = template, 0, 0, None
+        stored_lrs = (
+            np.zeros_like(self._lrs_host)
+            if self._lrs_host is not None else None
+        )
+        if is_coordinator():
+            try:
+                path = latest_sweep_state(self.log_dir)
+                if path is None:
+                    self._note_no_population_file()
+                else:
+                    restored, steps, stored_lrs = (
+                        self._read_population_file(path)
+                    )
+                    found = 1
+            except BaseException as e:  # noqa: BLE001 — incl. SystemExit;
+                # converted to fail-fast after releasing the peers
+                restored, err = template, e
+        found = int(multihost_utils.broadcast_one_to_all(np.int32(found)))
+        if err is not None:
+            raise err
+        if not found:
+            return
+        payload = [restored, np.int64(steps)]
+        if stored_lrs is not None:
+            payload.append(np.asarray(stored_lrs, np.float32))
+        payload = multihost_utils.broadcast_one_to_all(payload)
+        restored, steps = payload[0], int(payload[1])
+        if stored_lrs is not None:
+            self._adopt_checkpoint_lrs(np.asarray(payload[2]))
+        start, count = self._member_slice()
+        local = jax.tree_util.tree_map(
+            lambda x: x[start : start + count], restored
+        )
+        placed = global_from_local(local, self._mesh)
+        self.train_state = self.train_state.replace(
+            params=placed["params"], opt_state=placed["opt_state"]
+        )
+        self.key = placed["key"]
+        self.env_state = placed["env_state"]
+        self.obs = placed["obs"]
+        self.num_timesteps = steps
         print(
-            f"[sweep] resumed {self.num_seeds}-member population from "
-            f"{path} at {self.num_timesteps} steps"
+            f"[sweep] process {jax.process_index()} resumed "
+            f"{self.num_seeds}-member population (broadcast) at "
+            f"{self.num_timesteps} steps"
         )
 
     def train(self) -> Dict[str, float]:
@@ -489,7 +649,7 @@ class SweepTrainer:
                     * self.num_seeds
                 )
                 if iteration % self.config.log_interval == 0:
-                    host = jax.device_get(metrics)  # one batched pull
+                    host = self._to_host(metrics)  # one batched pull
                     record = self._aggregate(host)
                     record["env_steps_per_sec"] = meter.rate()
                     logger.log(record, self.num_timesteps)
@@ -502,7 +662,7 @@ class SweepTrainer:
                 # Rank on the FINAL iteration's rewards even when
                 # log_interval didn't land on it — a stale ranking would
                 # disagree with the final checkpoints it points at.
-                final = jax.device_get(metrics)
+                final = self._to_host(metrics)
                 record = self._aggregate(final)
                 record["env_steps_per_sec"] = meter.rate()
                 if self.config.checkpoint:
@@ -525,7 +685,9 @@ class SweepTrainer:
         return record
 
     def _write_summary(self, rewards: Optional[np.ndarray]) -> None:
-        if rewards is None:
+        from marl_distributedformation_tpu.parallel import is_coordinator
+
+        if rewards is None or not is_coordinator():
             return
         summary = {
             "seeds": [

@@ -36,7 +36,7 @@ def sweep_state_path(log_dir: str | Path, num_timesteps: int) -> Path:
 
 
 def save_checkpoint(
-    log_dir: str | Path, num_timesteps: int, target: Any
+    log_dir: str | Path, num_timesteps: int, target: Any, sync: bool = True
 ) -> Optional[Path]:
     """Serialize ``target`` (any pytree) to ``rl_model_{steps}_steps.msgpack``.
 
@@ -60,7 +60,11 @@ def save_checkpoint(
     on_coordinator = is_coordinator()
     if on_coordinator:
         _write_atomic(path, target)
-    if jax.process_count() > 1:
+    if sync and jax.process_count() > 1:
+        # ``sync=False`` lets a caller writing MANY files per logical
+        # checkpoint (the sweep's per-member loop) batch the durability
+        # barrier into one trailing synced write instead of paying a
+        # cross-host round trip per file.
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"ckpt_{num_timesteps}")
@@ -85,13 +89,26 @@ def _write_atomic(path: Path, target: Any) -> None:
 
 def save_sweep_state(
     log_dir: str | Path, num_timesteps: int, target: Any
-) -> Path:
-    """Write the full population state of a sweep (train/sweep.py) —
-    single-controller only (SweepTrainer asserts process_count == 1), so
-    no multi-host barrier."""
+) -> Optional[Path]:
+    """Write the full population state of a sweep (train/sweep.py).
+    Multi-host: coordinator-only write + durability barrier, same contract
+    as :func:`save_checkpoint` (``target`` must be host-addressable on the
+    coordinator — SweepTrainer passes the allgathered host population)."""
+    import jax
+
+    from marl_distributedformation_tpu.parallel.distributed import (
+        is_coordinator,
+    )
+
     path = sweep_state_path(log_dir, num_timesteps)
-    _write_atomic(path, target)
-    return path
+    on_coordinator = is_coordinator()
+    if on_coordinator:
+        _write_atomic(path, target)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"sweep_state_{num_timesteps}")
+    return path if on_coordinator else None
 
 
 def latest_sweep_state(log_dir: str | Path) -> Optional[Path]:

@@ -126,7 +126,26 @@ def main() -> None:
             file=sys.stderr,
         )
 
-    preset_margin = rows[0]["margin_vs_baseline"]
+    # Anchor the guard on the preset point explicitly; a custom
+    # TUNE_POINTS list without it falls back to its first row — say so,
+    # since quality_ok then means "vs that row", not "vs the preset".
+    anchor = next(
+        (
+            r for r in rows
+            if r["batch_size"] == 8192 and r["learning_rate"] == 1.0e-3
+        ),
+        rows[0],
+    )
+    if anchor is rows[0] and (
+        anchor["batch_size"] != 8192 or anchor["learning_rate"] != 1.0e-3
+    ):
+        print(
+            "[tune] note: preset point (8192, 1e-3) not in TUNE_POINTS; "
+            f"quality guard anchors on batch={anchor['batch_size']} "
+            f"lr={anchor['learning_rate']:g} instead",
+            file=sys.stderr,
+        )
+    preset_margin = anchor["margin_vs_baseline"]
     for r in rows:
         # Rewards are negative-cost shaped; "keeps quality" = margin not
         # materially below the preset point's.
@@ -143,6 +162,10 @@ def main() -> None:
         "baseline_return": round(base["episode_return_per_agent"], 3),
         "zero_return": round(zero["episode_return_per_agent"], 3),
         "device": jax.devices()[0].device_kind,
+        "guard_anchor": {
+            "batch_size": anchor["batch_size"],
+            "learning_rate": anchor["learning_rate"],
+        },
         "points": rows,
         "best_quality_ok": best,
     }

@@ -148,3 +148,132 @@ def test_two_process_training_and_broadcast_resume(tmp_path):
     # Exactly one checkpoint series on disk, written by the coordinator.
     files = sorted(log_dir.glob("rl_model_*_steps.msgpack"))
     assert files, "coordinator wrote no checkpoints"
+
+
+SWEEP_WORKER = """
+import sys
+
+sys.path.insert(0, "__REPO_ROOT__")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from marl_distributedformation_tpu.parallel import (
+    init_distributed,
+    make_hybrid_mesh,
+)
+
+assert init_distributed(), "env-var wiring must produce a multi-process runtime"
+assert jax.process_count() == 2 and len(jax.devices()) == 4
+
+from marl_distributedformation_tpu.algo import PPOConfig
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.train import SweepTrainer, TrainConfig
+
+log_dir = sys.argv[1]
+mesh = make_hybrid_mesh({"dp": -1})
+PPO = PPOConfig(n_steps=2, batch_size=12, n_epochs=1)
+PER_ITER = 2 * 2 * 3  # n_steps * M * N agent-transitions per member
+
+
+def build(resume, total):
+    return SweepTrainer(
+        EnvParams(num_agents=3, max_steps=8),
+        ppo=PPO,
+        config=TrainConfig(
+            num_formations=2,
+            checkpoint=True,
+            save_freq=10**9,
+            name="mhsweep",
+            log_dir=log_dir,
+            resume=resume,
+            total_timesteps=total,
+        ),
+        num_seeds=4,
+        mesh=mesh,
+        learning_rates=[1e-3, 2e-3, 3e-3, 4e-3],
+    )
+
+
+sweep = build(resume=False, total=PER_ITER)
+sweep.train()  # one iteration, then save() + summary on the coordinator
+pre = sweep._to_host({"params": sweep.train_state.params})
+print(f"TRAINED p{jax.process_index()} steps={sweep.num_timesteps}", flush=True)
+
+resumed = build(resume=True, total=2 * PER_ITER)
+assert resumed.num_timesteps == PER_ITER, resumed.num_timesteps
+post = resumed._to_host({"params": resumed.train_state.params})
+for a, b in zip(
+    jax.tree_util.tree_leaves(pre), jax.tree_util.tree_leaves(post)
+):
+    assert (np.asarray(a) == np.asarray(b)).all(), "restore not bit-exact"
+host_m = resumed._to_host(resumed.run_iteration())
+print(
+    f"RESUMED p{jax.process_index()} steps={resumed.num_timesteps} "
+    f"reward0={float(host_m['reward'][0]):.6f}",
+    flush=True,
+)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_population_sweep(tmp_path):
+    """Multi-host population sweep end-to-end: per-host member
+    construction, SPMD training over the global mesh, coordinator-only
+    member/population checkpoints, bit-exact broadcast resume."""
+    worker = tmp_path / "sweep_worker.py"
+    worker.write_text(SWEEP_WORKER.replace("__REPO_ROOT__", str(REPO)))
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        )
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(worker), str(log_dir)],
+                env=env,
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"TRAINED p{pid}" in out, out
+        assert f"RESUMED p{pid}" in out, out
+    # The post-resume iteration is globally synchronized: member 0's
+    # reward must agree across processes.
+    rewards = {
+        line.split("reward0=")[1]
+        for out in outs
+        for line in out.splitlines()
+        if "RESUMED" in line
+    }
+    assert len(rewards) == 1, f"post-resume member rewards diverged: {rewards}"
+    # Coordinator wrote per-member checkpoints, the population state, and
+    # the ranking summary.
+    for i in range(4):
+        assert list((log_dir / f"seed{i}").glob("rl_model_*_steps.msgpack"))
+    assert list(log_dir.glob("sweep_state_*_steps.msgpack"))
+    assert (log_dir / "sweep_summary.json").exists()
