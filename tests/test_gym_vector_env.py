@@ -1,0 +1,82 @@
+"""gymnasium.vector.VectorEnv adapter (compat/gym_vector_env.py).
+
+Pins the vector API contract (spaces, shapes, SAME_STEP autoreset
+declaration), semantic agreement with the single-env adapter, and the
+truncation timing the reference's timeout-only episodes imply.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+gym = pytest.importorskip("gymnasium")
+
+from marl_distributedformation_tpu.compat.gym_env import (  # noqa: E402
+    FormationGymEnv,
+)
+from marl_distributedformation_tpu.compat.gym_vector_env import (  # noqa: E402
+    FormationVectorEnv,
+)
+from marl_distributedformation_tpu.env import EnvParams  # noqa: E402
+
+
+def test_vector_api_contract():
+    env = FormationVectorEnv(EnvParams(num_agents=4, max_steps=8), num_envs=3)
+    assert env.metadata["autoreset_mode"] == gym.vector.AutoresetMode.SAME_STEP
+    assert env.single_observation_space.shape == (4, env.params.obs_dim)
+    assert env.single_action_space.shape == (4, 2)
+    assert env.observation_space.shape == (3, 4, env.params.obs_dim)
+    obs, info = env.reset(seed=0)
+    assert obs.shape == (3, 4, env.params.obs_dim)
+    assert env.observation_space.contains(obs)
+    act = np.asarray(env.action_space.sample(), np.float32)
+    obs2, rewards, terminated, truncated, infos = env.step(act)
+    assert obs2.shape == obs.shape
+    assert rewards.shape == terminated.shape == truncated.shape == (3,)
+    assert terminated.dtype == truncated.dtype == bool
+    assert infos["steps"].tolist() == [1, 1, 1]
+    assert "avg_dist_to_goal" in infos
+    env.close()
+
+
+def test_matches_single_env_semantics():
+    """Formation 0 of the vector env == the single-env adapter under the
+    same seed: the vector adapter is pure batching, not a reimplement."""
+    params = EnvParams(num_agents=3)
+    vec = FormationVectorEnv(params, num_envs=1)
+    single = FormationGymEnv(params)
+    ov, _ = vec.reset(seed=11)
+    os_, _ = single.reset(seed=11)
+    np.testing.assert_array_equal(ov[0], os_)
+    act = np.full((3, 2), 0.25, np.float32)
+    for _ in range(3):
+        ov, rv, tv, cv, _ = vec.step(act[None])
+        os_, rs, ts, cs, _ = single.step(act)
+    np.testing.assert_array_equal(ov[0], os_)
+    np.testing.assert_allclose(rv[0], rs, rtol=1e-6)
+    assert bool(tv[0]) == ts and bool(cv[0]) == cs
+
+
+def test_truncates_and_autoresets_same_step():
+    env = FormationVectorEnv(
+        EnvParams(num_agents=3, max_steps=8), num_envs=2
+    )
+    env.reset(seed=0)
+    act = np.zeros((2, 3, 2), np.float32)
+    for i in range(1, 11):
+        obs, _, terminated, truncated, infos = env.step(act)
+        assert not terminated.any()  # timeout-only episodes (Q3)
+        if truncated.all():
+            break
+    assert i == 10  # max_steps + 2 (Q1 off-by-one, deliberate)
+    # SAME_STEP autoreset: the step that truncates already returns the
+    # next episode's first obs and resets the step counters.
+    assert infos["steps"].tolist() == [10, 10]
+    obs2, _, _, truncated2, infos2 = env.step(act)
+    assert not truncated2.any()
+    assert infos2["steps"].tolist() == [1, 1]
+    assert np.isfinite(obs2).all()
