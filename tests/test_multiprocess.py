@@ -277,3 +277,131 @@ def test_two_process_population_sweep(tmp_path):
         assert list((log_dir / f"seed{i}").glob("rl_model_*_steps.msgpack"))
     assert list(log_dir.glob("sweep_state_*_steps.msgpack"))
     assert (log_dir / "sweep_summary.json").exists()
+
+
+HETERO_WORKER = """
+import sys
+
+sys.path.insert(0, "__REPO_ROOT__")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from marl_distributedformation_tpu.parallel import (
+    init_distributed,
+    make_hybrid_mesh,
+    make_shard_fn,
+)
+
+assert init_distributed(), "env-var wiring must produce a multi-process runtime"
+assert jax.process_count() == 2 and len(jax.devices()) == 4
+
+from marl_distributedformation_tpu.algo import PPOConfig
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.train import (
+    Curriculum,
+    CurriculumStage,
+    HeteroTrainer,
+    TrainConfig,
+)
+
+log_dir = sys.argv[1]
+mesh = make_hybrid_mesh({"dp": -1})
+CURRICULUM = Curriculum(
+    stages=(
+        CurriculumStage(rollouts=1, agent_counts=(3,)),
+        CurriculumStage(rollouts=1, agent_counts=(3, 4), num_obstacles=1),
+    )
+)
+
+
+def build(resume):
+    return HeteroTrainer(
+        curriculum=CURRICULUM,
+        env_params=EnvParams(num_agents=3, max_steps=8),
+        ppo=PPOConfig(n_steps=2, batch_size=32, n_epochs=1),
+        config=TrainConfig(
+            num_formations=8,
+            checkpoint=True,
+            save_freq=1,
+            name="mh-hetero",
+            log_dir=log_dir,
+            resume=resume,
+        ),
+        shard_fn=make_shard_fn(mesh=mesh),
+    )
+
+
+trainer = build(resume=False)
+trainer.train()  # both stages incl. the mixed-size + obstacle transition
+assert trainer.completed_rollouts == 2, trainer.completed_rollouts
+print(f"TRAINED p{jax.process_index()} steps={trainer.num_timesteps}", flush=True)
+
+resumed = build(resume=True)  # broadcast restore incl. completed_rollouts
+assert resumed.completed_rollouts == 2, resumed.completed_rollouts
+assert resumed.num_timesteps == trainer.num_timesteps
+# Continue past the recorded curriculum: re-enter the last stage and run
+# one more globally synchronized iteration from the restored params.
+resumed.start_stage(CURRICULUM.stages[-1])
+loss = float(resumed.run_iteration()["loss"])
+print(
+    f"RESUMED p{jax.process_index()} steps={resumed.num_timesteps} "
+    f"loss={loss:.6f}",
+    flush=True,
+)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_hetero_curriculum(tmp_path):
+    """Multi-host heterogeneous curriculum end-to-end: per-host padded
+    stage construction (hetero_reset_batch_sharded), a stage transition
+    under SPMD, coordinator-only checkpoints, broadcast resume with the
+    rollout cursor."""
+    worker = tmp_path / "hetero_worker.py"
+    worker.write_text(HETERO_WORKER.replace("__REPO_ROOT__", str(REPO)))
+    log_dir = tmp_path / "logs"
+    port = _free_port()
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        )
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(worker), str(log_dir)],
+                env=env,
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"TRAINED p{pid}" in out, out
+        assert f"RESUMED p{pid}" in out, out
+    losses = {
+        line.split("loss=")[1]
+        for out in outs
+        for line in out.splitlines()
+        if "RESUMED" in line
+    }
+    assert len(losses) == 1, f"post-resume losses diverged: {losses}"
+    assert list(log_dir.glob("rl_model_*_steps.msgpack"))
