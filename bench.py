@@ -346,30 +346,6 @@ def main() -> None:
                         f"({tuned_iters:.2f} iters/s)",
                         file=sys.stderr,
                     )
-                    # Tuned + scan-fused multi-iteration dispatch: the
-                    # per-dispatch RTT amortization the trainer exposes as
-                    # iters_per_dispatch (VERDICT r3 #6). Compile cost
-                    # scales with the burst length, so keep it modest.
-                    fused_r = _env_int(
-                        "BENCH_ITERS_PER_DISPATCH", 8 if on_accel else 2
-                    )
-                    if fused_r > 1 and time.time() < deadline - 30:
-                        fused_rate, fused_iters, _ = _time_train_phase(
-                            N, train_m, deadline,
-                            ppo=PPOConfig(batch_size=8192),
-                            iters_per_dispatch=fused_r,
-                        )
-                        result["train_env_steps_per_sec_tuned_fused"] = (
-                            round(fused_rate, 1)
-                        )
-                        result["train_tuned_iters_per_dispatch"] = fused_r
-                        print(
-                            f"[bench] train (tuned, "
-                            f"iters_per_dispatch={fused_r}): "
-                            f"{fused_rate:,.0f} formation-steps/s "
-                            f"({fused_iters:.2f} iters/s)",
-                            file=sys.stderr,
-                        )
                 except Exception as e:  # noqa: BLE001 — degrade, don't die
                     notes.append(f"train phase failed: {e!r}"[:200])
             else:
@@ -477,6 +453,45 @@ def main() -> None:
                 )
             else:
                 notes.append("knn-big phase skipped: deadline")
+
+        # Phase 5 — tuned + scan-fused multi-iteration dispatch: the
+        # per-dispatch RTT amortization the trainer exposes as
+        # iters_per_dispatch (VERDICT r3 #6). Runs LAST: its scan compile
+        # is the most expensive and must never starve the long-standing
+        # knn fields of deadline budget.
+        if os.environ.get("BENCH_SKIP_TRAIN") != "1":
+            fused_r = _env_int(
+                "BENCH_ITERS_PER_DISPATCH", 8 if on_accel else 2
+            )
+            if fused_r <= 1:
+                pass  # explicitly disabled
+            elif time.time() < deadline - 30:
+                try:
+                    from marl_distributedformation_tpu.algo import PPOConfig
+
+                    train_m = _env_int(
+                        "BENCH_TRAIN_M", M if on_accel else 256
+                    )
+                    fused_rate, fused_iters, _ = _time_train_phase(
+                        N, train_m, deadline,
+                        ppo=PPOConfig(batch_size=8192),
+                        iters_per_dispatch=fused_r,
+                    )
+                    result["train_env_steps_per_sec_tuned_fused"] = round(
+                        fused_rate, 1
+                    )
+                    result["train_tuned_iters_per_dispatch"] = fused_r
+                    print(
+                        f"[bench] train (tuned, "
+                        f"iters_per_dispatch={fused_r}): "
+                        f"{fused_rate:,.0f} formation-steps/s "
+                        f"({fused_iters:.2f} iters/s)",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade, don't die
+                    notes.append(f"fused train phase failed: {e!r}"[:200])
+            else:
+                notes.append("fused train phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:
