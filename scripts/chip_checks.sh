@@ -62,5 +62,7 @@ cat /tmp/train_tuning.json
 echo "== full bench =="
 python bench.py | tail -1 > /tmp/bench_tpu.json
 cat /tmp/bench_tpu.json
+python scripts/mirror_bench.py /tmp/bench_tpu.json \
+    docs/acceptance/tpu_bench_r4.md
 
 echo "== done — review artifacts, then commit =="
