@@ -80,3 +80,22 @@ def test_truncates_and_autoresets_same_step():
     assert not truncated2.any()
     assert infos2["steps"].tolist() == [1, 1]
     assert np.isfinite(obs2).all()
+
+
+def test_standard_vector_wrapper_composes():
+    """A stock gymnasium vector wrapper (RecordEpisodeStatistics) drives
+    the adapter unchanged — the ecosystem-interop claim, exercised."""
+    env = FormationVectorEnv(
+        EnvParams(num_agents=3, max_steps=8), num_envs=2
+    )
+    wrapped = gym.wrappers.vector.RecordEpisodeStatistics(env)
+    wrapped.reset(seed=0)
+    act = np.zeros((2, 3, 2), np.float32)
+    stats = None
+    for _ in range(10):
+        _, _, _, _, infos = wrapped.step(act)
+        if "episode" in infos:
+            stats = infos["episode"]
+    assert stats is not None, "wrapper never reported episode stats"
+    assert np.asarray(stats["l"]).tolist() == [10, 10]  # Q1 episode length
+    assert np.isfinite(np.asarray(stats["r"])).all()
