@@ -1,68 +1,22 @@
 #!/usr/bin/env bash
-# Run every hardware-dependent validation in one go and refresh the
-# committed artifacts. Run from the repo root when the TPU tunnel is up
-# (probe first: the tunnel drops for hours — bench.py's subprocess probe
-# pattern; a bare jax.devices() can hang forever).
+# Run every hardware-dependent validation from scratch and refresh the
+# committed artifacts. Since round 4 this is a thin wrapper over the
+# stage-stamped chip-window burster (scripts/chip_window.sh) — clearing
+# the stamp state first so everything re-runs — because the tunnel now
+# surfaces in short windows and the burster's per-stage resume is the
+# only design that survives a mid-run drop. For incremental/opportunistic
+# runs use chip_window.sh directly (or scripts/chip_watchdog.sh to poll
+# for windows automatically).
 #
 #   bash scripts/chip_checks.sh
 #
-# Artifacts refreshed:
-#   docs/acceptance/tpu_parity.txt   (k-NN parity, BOTH kernels, f64 anchor)
-#   docs/profiling.md table input    (stdout of tpu_profile_breakdown)
-#   /tmp/bench_tpu.json              (full bench line — inspect, then
-#                                     mirror into docs/acceptance/ if it
-#                                     supersedes tpu_bench_r3.md)
+# Artifacts refreshed (by the burster):
+#   docs/acceptance/tpu_parity.txt    (k-NN parity, BOTH kernels, f64 anchor)
+#   docs/acceptance/tpu_bench_r4.md   (mirrored full-bench JSON)
+#   docs/acceptance/tpu_smoke.txt     (per-path hardware smoke lines)
+#   /tmp/{profile,tuning,sweep_bench}_out.txt, logs/{hetero5,sweep8}_tpu/
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== probe =="
-python - <<'EOF'
-import subprocess, sys
-try:
-    out = subprocess.run(
-        [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-        capture_output=True, text=True, timeout=90,
-    )
-except subprocess.TimeoutExpired:
-    print("probe: TIMEOUT — tunnel down, aborting chip checks")
-    sys.exit(1)
-platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
-print("platform:", platform or out.stderr[-200:])
-sys.exit(0 if platform and platform != "cpu" else 1)
-EOF
-
-echo "== all-paths training smoke (one iteration per path) =="
-python scripts/tpu_smoke.py
-
-echo "== k-NN hardware parity (fused + chunked kernels, f64 anchor) =="
-python tests/tpu_compiled_parity.py | tee /tmp/parity_out.txt
-# Build the artifact in a temp file and rename atomically: a tunnel drop
-# mid-pipeline once truncated the committed artifact to its header.
-{
-  echo "# TPU hardware k-NN parity artifact"
-  echo "# command: python tests/tpu_compiled_parity.py"
-  echo "# date: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
-  python -c "import jax; print('# device:', jax.devices()[0].device_kind, '| backend:', jax.default_backend())" | grep '^#'
-  grep PARITY /tmp/parity_out.txt
-} > /tmp/tpu_parity.txt.tmp
-grep -q PARITY /tmp/tpu_parity.txt.tmp  # refuse to publish a header-only file
-mv /tmp/tpu_parity.txt.tmp docs/acceptance/tpu_parity.txt
-cat docs/acceptance/tpu_parity.txt
-
-echo "== training profile breakdown (parity vs preset=tpu) =="
-python scripts/tpu_profile_breakdown.py 4096
-
-echo "== population sweep amortization (K=8) =="
-python scripts/tpu_sweep_bench.py 8 512
-
-echo "== big-batch training tuning (16k/32k with lr scaling + eval guard) =="
-python scripts/tpu_train_tuning.py 4096 120 | tail -1 > /tmp/train_tuning.json
-cat /tmp/train_tuning.json
-
-echo "== full bench =="
-python bench.py | tail -1 > /tmp/bench_tpu.json
-cat /tmp/bench_tpu.json
-python scripts/mirror_bench.py /tmp/bench_tpu.json \
-    docs/acceptance/tpu_bench_r4.md
-
-echo "== done — review artifacts, then commit =="
+rm -rf /tmp/chip_state
+exec bash scripts/chip_window.sh

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Tunnel watchdog: probe every POLL_S seconds and fire the chip-window
+# burster (scripts/chip_window.sh) whenever the TPU tunnel is up, until
+# every stage is stamped or MAX_LIFE_S elapses. Detach it so it outlives
+# any one shell:
+#
+#   setsid nohup bash scripts/chip_watchdog.sh >> /tmp/chip_watchdog.log 2>&1 &
+#
+# The burster takes its own flock (auto-released on process death), so
+# concurrent ticks or a manual run simply bounce off it. All progress
+# lands in /tmp/chip_watchdog.log and /tmp/chip_state/.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+POLL_S=${POLL_S:-120}
+MAX_LIFE_S=${MAX_LIFE_S:-39600}  # 11h
+STATE=/tmp/chip_state
+start=$(date +%s)
+
+echo "[watchdog] start $(date -u +%Y-%m-%dT%H:%M:%SZ) poll=${POLL_S}s"
+while true; do
+  # The burster owns the stage list; it stamps ALL_DONE when every stage
+  # it defines is stamped — no stage-name copy here to drift.
+  if [ -f "$STATE/ALL_DONE" ]; then
+    echo "[watchdog] all stages stamped — done $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    exit 0
+  fi
+  if [ $(( $(date +%s) - start )) -gt "$MAX_LIFE_S" ]; then
+    echo "[watchdog] lifetime exceeded; stamps present:"
+    ls "$STATE" 2>/dev/null
+    exit 1
+  fi
+  bash scripts/chip_window.sh
+  rc=$?
+  if [ "$rc" -eq 73 ]; then
+    echo "[watchdog] burster already running; skipping tick"
+  elif [ "$rc" -ne 0 ]; then
+    echo "[watchdog] burster failed (rc=$rc); will retry next tick"
+  fi
+  sleep "$POLL_S"
+done

@@ -1,0 +1,201 @@
+#!/usr/bin/env bash
+# Opportunistic chip-window burster.
+#
+# The TPU tunnel now surfaces in SHORT windows (minutes), not long
+# uptime: a sequential 15-minute pipeline (scripts/chip_checks.sh) loses
+# everything when the tunnel drops mid-stage. This script runs the same
+# validation queue as a sequence of independently-stamped stages in
+# VALUE order (parity artifact > bench JSON > smoke > profile > tuning >
+# sweep bench > acceptance training runs), so each window makes forward
+# progress and the next window resumes from the first missing stamp:
+#
+#   bash scripts/chip_window.sh            # run whatever is still missing
+#   rm -rf /tmp/chip_state                 # force a full re-run
+#
+# Every stage runs under `timeout` (a tunnel drop mid-op hangs forever —
+# the round-3 lesson), stamps /tmp/chip_state/<stage> only on success,
+# and a failure triggers a re-probe: tunnel down => exit (window over),
+# tunnel up => keep going (the stage itself failed; don't block others).
+# Driven automatically by scripts/chip_watchdog.sh.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+STATE=/tmp/chip_state
+export STATE  # stage functions run under `bash -c` and read it
+mkdir -p "$STATE" docs/acceptance
+
+# The burster owns the single chip and the shared /tmp artifacts: one
+# instance at a time, whether fired by the watchdog or by hand. The lock
+# lives HERE (not in the watchdog) so a manual run can't race a tick.
+# Self-exec under flock's command form — the bare fd form does not hold
+# the lock past the flock utility's exit on this system (verified) — so
+# the lock spans the whole run and auto-releases when it dies. Exit 73
+# means "another run holds the lock".
+if [ "${CHIP_WINDOW_LOCKED:-}" != 1 ]; then
+  export CHIP_WINDOW_LOCKED=1
+  exec flock -n -E 73 /tmp/chip_window.lock bash "$0" "$@"
+fi
+
+probe() {
+  python - <<'EOF'
+import subprocess, sys
+try:
+    out = subprocess.run(
+        [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=90,
+    )
+except subprocess.TimeoutExpired:
+    sys.exit(1)
+platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+sys.exit(0 if platform and platform != "cpu" else 1)
+EOF
+}
+
+# stage <name> <timeout_s> <fn>: skip if stamped; run the exported shell
+# function under timeout (timeout(1) can't exec a function, so it goes
+# through bash -c); stamp on success; on failure re-probe and exit 0 if
+# the window closed.
+ALL_STAGES=()
+stage() {
+  local name="$1" tmo="$2" fn="$3"
+  ALL_STAGES+=("$name")
+  if [ -f "$STATE/$name" ]; then return 0; fi
+  echo "== stage $name (timeout ${tmo}s) $(date -u +%H:%M:%SZ) =="
+  if timeout "$tmo" bash -c "set -uo pipefail; $fn"; then
+    touch "$STATE/$name"
+    echo "== stage $name OK =="
+  else
+    echo "== stage $name FAILED/TIMED OUT — re-probing tunnel =="
+    if ! probe; then
+      echo "== tunnel down; window over $(date -u +%H:%M:%SZ) =="
+      exit 0
+    fi
+  fi
+}
+
+if ! probe; then
+  echo "probe: tunnel down, nothing to do"
+  exit 0
+fi
+echo "== window open $(date -u +%Y-%m-%dT%H:%M:%SZ) =="
+
+# -- 1. k-NN hardware parity (both kernels, f64 anchor) + artifact ------
+parity_stage() {
+  python tests/tpu_compiled_parity.py | tee /tmp/parity_out.txt || return 1
+  {
+    echo "# TPU hardware k-NN parity artifact"
+    echo "# command: python tests/tpu_compiled_parity.py"
+    echo "# date: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    python -c "import jax; print('# device:', jax.devices()[0].device_kind, '| backend:', jax.default_backend())" | grep '^#'
+    grep PARITY /tmp/parity_out.txt
+  } > /tmp/tpu_parity.txt.tmp
+  grep -q PARITY /tmp/tpu_parity.txt.tmp || return 1
+  mv /tmp/tpu_parity.txt.tmp docs/acceptance/tpu_parity.txt
+  cat docs/acceptance/tpu_parity.txt
+}
+export -f parity_stage
+stage parity 600 parity_stage
+
+# -- 2. full bench (incl. the never-measured knn_big pallas phase) ------
+bench_stage() {
+  BENCH_BUDGET_S=420 python bench.py | tail -1 > /tmp/bench_tpu.json || return 1
+  cat /tmp/bench_tpu.json
+  # Hardware evidence only: refuse to stamp a fallback line, an errored
+  # run (e.g. bench.py's own watchdog fired mid-hang — it still emits a
+  # JSON line, with an "error" field and value 0), or a zero headline.
+  python - <<'EOF' || return 1
+import json
+rec = json.load(open("/tmp/bench_tpu.json"))
+assert not rec.get("fallback"), "bench fell back to CPU"
+assert rec.get("platform") != "cpu", rec.get("platform")
+assert "error" not in rec, rec.get("error")
+assert float(rec.get("value", 0.0)) > 0.0, "zero headline rate"
+EOF
+  python scripts/mirror_bench.py /tmp/bench_tpu.json docs/acceptance/tpu_bench_r4.md
+}
+export -f bench_stage
+stage bench 600 bench_stage
+
+# -- 3. remaining all-paths smoke (per-path stamps) ---------------------
+smoke_stage() {
+  # Path names come from the script itself (--list) — no drifting copy.
+  # One process + stamp PER PATH, so a tunnel drop mid-path keeps every
+  # earlier pass (a single batched run would lose all its stamps when
+  # the stage timeout kills the wrapper before the stamping loop).
+  local paths
+  paths=$(python scripts/tpu_smoke.py --list) || return 1
+  [ -n "$paths" ] || return 1  # an empty list must never stamp success
+  for p in $paths; do
+    [ -f "$STATE/smoke_$p" ] && continue
+    if timeout 420 python scripts/tpu_smoke.py "$p" | tee /tmp/smoke_out.txt \
+        && grep -q "SMOKE_OK: $p " /tmp/smoke_out.txt; then
+      touch "$STATE/smoke_$p"
+      grep "SMOKE_OK: $p " /tmp/smoke_out.txt \
+        | sed "s/^/$(date -u +%Y-%m-%dT%H:%M:%SZ) /" >> docs/acceptance/tpu_smoke.txt
+    else
+      return 1
+    fi
+  done
+  return 0
+}
+export -f smoke_stage
+stage smoke 3000 smoke_stage
+
+# -- 4. training profile breakdown --------------------------------------
+profile_stage() {
+  python scripts/tpu_profile_breakdown.py 4096 | tee /tmp/profile_out.txt
+}
+export -f profile_stage
+stage profile 600 profile_stage
+
+# -- 5. big-batch tuning (lr scaling + eval quality guard) --------------
+tuning_stage() {
+  python scripts/tpu_train_tuning.py 4096 120 | tee /tmp/tuning_out.txt
+  grep -q '"metric"' /tmp/tuning_out.txt
+}
+export -f tuning_stage
+stage tuning 900 tuning_stage
+
+# -- 6. population sweep amortization -----------------------------------
+sweep_bench_stage() {
+  python scripts/tpu_sweep_bench.py 8 512 | tee /tmp/sweep_bench_out.txt
+}
+export -f sweep_bench_stage
+stage sweep_bench 600 sweep_bench_stage
+
+# -- 7. config-5 hetero curriculum acceptance on the chip ---------------
+hetero5_stage() {
+  python train.py name=hetero5_tpu num_formation=64 \
+    num_agents_per_formation=20 preset=tpu total_timesteps=1280000 \
+    use_wandb=false \
+    "curriculum=[{rollouts: 30, agent_counts: [5]}, {rollouts: 40, agent_counts: [5, 20]}, {rollouts: 30, agent_counts: [5, 20], num_obstacles: 4}]"
+}
+export -f hetero5_stage
+stage hetero5 1800 hetero5_stage
+
+# -- 8. sweep workflow acceptance on the chip ---------------------------
+sweep8_stage() {
+  python train.py name=sweep8_tpu num_seeds=8 \
+    num_formation=16 num_agents_per_formation=3 \
+    strict_parity=false max_steps=64 \
+    n_steps=16 batch_size=192 n_epochs=4 \
+    total_timesteps=153600 save_freq=3200 use_wandb=false || return 1
+  python evaluate.py name=sweep8_tpu num_formation=16 \
+    num_agents_per_formation=3 strict_parity=false max_steps=64
+}
+export -f sweep8_stage
+stage sweep8 1800 sweep8_stage
+
+echo "== window pass complete $(date -u +%Y-%m-%dT%H:%M:%SZ); state: =="
+ls "$STATE"
+
+# Sentinel for the watchdog: the stage list lives only in THIS file, so
+# done-ness is decided here, not by a drifting copy in the watchdog.
+done=1
+for s in "${ALL_STAGES[@]}"; do
+  [ -f "$STATE/$s" ] || done=0
+done
+if [ "$done" -eq 1 ]; then
+  touch "$STATE/ALL_DONE"
+  echo "== ALL stages stamped =="
+fi

@@ -12,6 +12,12 @@ one SMOKE_OK/SMOKE_FAIL line each. Run via scripts/chip_checks.sh or:
 
     python scripts/tpu_smoke.py        # ~2-3 min incl. compiles
     python scripts/tpu_smoke.py cpu    # off-chip smoke of the script itself
+    python scripts/tpu_smoke.py gnn_knn100 sweep_k4   # just these paths
+
+Naming paths on the CLI runs only those — the chip-window burster
+(scripts/chip_window.sh) uses this to resume after a tunnel drop killed a
+partial run, instead of re-paying every compile for paths that already
+passed inside an earlier window.
 """
 
 from __future__ import annotations
@@ -23,8 +29,20 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+# Single source of truth for the path names — `--list` prints these so
+# shell callers (scripts/chip_window.sh) never hardcode a drifting copy;
+# run_paths() asserts its dict matches.
+SMOKE_PATHS = (
+    "mlp_parity",
+    "mlp_tuned",
+    "ctde",
+    "gnn_knn100",
+    "hetero_curriculum",
+    "sweep_k4",
+)
 
-def run_paths(m: int = 256) -> dict:
+
+def run_paths(m: int = 256, only: list[str] | None = None) -> dict:
     import jax
     import numpy as np
 
@@ -118,6 +136,18 @@ def run_paths(m: int = 256) -> dict:
         )
     )
 
+    assert set(paths) == set(SMOKE_PATHS), (
+        "SMOKE_PATHS is out of sync with the paths dict: "
+        f"{sorted(set(paths) ^ set(SMOKE_PATHS))}"
+    )
+    if only:
+        unknown = sorted(set(only) - set(paths))
+        if unknown:
+            raise SystemExit(
+                f"unknown smoke path(s) {unknown}; have {sorted(paths)}"
+            )
+        paths = {name: fn for name, fn in paths.items() if name in only}
+
     device = jax.devices()[0].device_kind
     results, failed = {}, []
     for name, fn in paths.items():
@@ -142,14 +172,20 @@ def run_paths(m: int = 256) -> dict:
 
 
 def main() -> None:
+    args = sys.argv[1:]
+    if "--list" in args:
+        print(" ".join(SMOKE_PATHS))
+        return
+
     import jax
 
-    cpu = "cpu" in sys.argv[1:]
+    cpu = "cpu" in args
+    only = [a for a in args if a != "cpu"]
     if cpu:
         jax.config.update("jax_platforms", "cpu")
     # Off-chip self-smoke shrinks the batch: it checks the script, not
     # host-CPU throughput.
-    summary = run_paths(m=32 if cpu else 256)
+    summary = run_paths(m=32 if cpu else 256, only=only or None)
     if summary["paths_failed"]:
         sys.exit(1)
 
