@@ -96,7 +96,28 @@ parity_stage() {
 export -f parity_stage
 stage parity 600 parity_stage
 
-# -- 2. full bench (incl. the never-measured knn_big pallas phase) ------
+# -- 2. knn_big alone — the one number that has NEVER been measured on
+# hardware (N=1024 chunked Pallas kernel past the VMEM cliff). A short
+# window must be able to secure it without finishing the full bench. ----
+knn_big_stage() {
+  BENCH_SKIP_TRAIN=1 BENCH_SKIP_KNN=1 BENCH_BUDGET_S=300 python bench.py \
+    | tail -1 > /tmp/bench_knn_big.json || return 1
+  cat /tmp/bench_knn_big.json
+  python - <<'EOF' || return 1
+import json
+rec = json.load(open("/tmp/bench_knn_big.json"))
+assert not rec.get("fallback"), "fell back to CPU"
+assert "error" not in rec, rec.get("error")
+assert rec.get("knn_big_impl") == "pallas_big", rec.get("knn_big_impl")
+assert float(rec.get("knn_big_env_steps_per_sec", 0.0)) > 0.0
+EOF
+  python scripts/mirror_bench.py /tmp/bench_knn_big.json \
+      docs/acceptance/tpu_knn_big_r4.md
+}
+export -f knn_big_stage
+stage knn_big 420 knn_big_stage
+
+# -- 3. full bench (incl. the knn_big pallas phase) ---------------------
 bench_stage() {
   BENCH_BUDGET_S=420 python bench.py | tail -1 > /tmp/bench_tpu.json || return 1
   cat /tmp/bench_tpu.json
@@ -116,7 +137,7 @@ EOF
 export -f bench_stage
 stage bench 600 bench_stage
 
-# -- 3. remaining all-paths smoke (per-path stamps) ---------------------
+# -- 4. remaining all-paths smoke (per-path stamps) ---------------------
 smoke_stage() {
   # Path names come from the script itself (--list) — no drifting copy.
   # One process + stamp PER PATH, so a tunnel drop mid-path keeps every
@@ -141,14 +162,14 @@ smoke_stage() {
 export -f smoke_stage
 stage smoke 3000 smoke_stage
 
-# -- 4. training profile breakdown --------------------------------------
+# -- 5. training profile breakdown --------------------------------------
 profile_stage() {
   python scripts/tpu_profile_breakdown.py 4096 | tee /tmp/profile_out.txt
 }
 export -f profile_stage
 stage profile 600 profile_stage
 
-# -- 5. big-batch tuning (lr scaling + eval quality guard) --------------
+# -- 6. big-batch tuning (lr scaling + eval quality guard) --------------
 tuning_stage() {
   python scripts/tpu_train_tuning.py 4096 120 | tee /tmp/tuning_out.txt
   grep -q '"metric"' /tmp/tuning_out.txt
@@ -156,14 +177,14 @@ tuning_stage() {
 export -f tuning_stage
 stage tuning 900 tuning_stage
 
-# -- 6. population sweep amortization -----------------------------------
+# -- 7. population sweep amortization -----------------------------------
 sweep_bench_stage() {
   python scripts/tpu_sweep_bench.py 8 512 | tee /tmp/sweep_bench_out.txt
 }
 export -f sweep_bench_stage
 stage sweep_bench 600 sweep_bench_stage
 
-# -- 7. config-5 hetero curriculum acceptance on the chip ---------------
+# -- 8. config-5 hetero curriculum acceptance on the chip ---------------
 hetero5_stage() {
   python train.py name=hetero5_tpu num_formation=64 \
     num_agents_per_formation=20 preset=tpu total_timesteps=1280000 \
@@ -173,7 +194,7 @@ hetero5_stage() {
 export -f hetero5_stage
 stage hetero5 1800 hetero5_stage
 
-# -- 8. sweep workflow acceptance on the chip ---------------------------
+# -- 9. sweep workflow acceptance on the chip ---------------------------
 sweep8_stage() {
   python train.py name=sweep8_tpu num_seeds=8 \
     num_formation=16 num_agents_per_formation=3 \
