@@ -73,6 +73,15 @@ stage() {
   fi
 }
 
+# Never contend with a foreign bench run for the single chip (the round
+# driver runs `python bench.py` for the official record; two processes
+# on one TPU skew both). Our own bench children run only while the lock
+# is held, i.e. after this check.
+if pgrep -f "python bench.py" >/dev/null 2>&1; then
+  echo "foreign bench.py run in progress; deferring this window"
+  exit 0
+fi
+
 if ! probe; then
   echo "probe: tunnel down, nothing to do"
   exit 0
