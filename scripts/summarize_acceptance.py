@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Summarize a training run's ``metrics.jsonl`` into acceptance markdown.
+
+Chip windows are short; landing a hardware acceptance run should be one
+command, not hand-edited tables. Reads ``logs/{name}/metrics.jsonl``
+(or any metrics file) and prints the markdown table the
+``docs/acceptance/*/README.md`` records use:
+
+    python scripts/summarize_acceptance.py logs/hetero5_tpu/metrics.jsonl
+    python scripts/summarize_acceptance.py logs/sweep8_tpu/metrics.jsonl
+
+- For curriculum runs (rows carry ``curriculum_stage``): one row per
+  stage boundary (first/last iteration of each stage) — reward +
+  avg_dist_to_goal, matching docs/acceptance/hetero5/README.md.
+- For sweep runs (rows carry ``reward_best``/``best_seed``): population
+  mean trajectory (first/mid/last) plus the final best/worst spread,
+  matching docs/acceptance/sweep8/README.md.
+- Otherwise: first/mid/last iteration rows.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+
+def load(path: Path) -> list[dict]:
+    rows = []
+    with path.open() as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                rows.append(json.loads(ln))
+    if not rows:
+        raise SystemExit(f"{path}: no metric rows")
+    return rows
+
+
+def fmt(x, nd=2):
+    return f"{x:.{nd}f}" if isinstance(x, (int, float)) else str(x)
+
+
+def curriculum_table(rows: list[dict]) -> str:
+    out = ["| iteration | stage | reward | avg_dist_to_goal |", "|---|---|---|---|"]
+    prev_stage = None
+    for i, r in enumerate(rows, 1):
+        stage = int(r.get("curriculum_stage", 0))
+        boundary = stage != prev_stage  # first row of a stage
+        last_of_stage = (
+            i == len(rows)
+            or int(rows[i].get("curriculum_stage", 0)) != stage
+        )
+        if boundary or last_of_stage:
+            out.append(
+                f"| {i} | {stage} | {fmt(r['reward'])} | "
+                f"{fmt(r['avg_dist_to_goal'], 1)} |"
+            )
+        prev_stage = stage
+    return "\n".join(out)
+
+
+def sweep_table(rows: list[dict]) -> str:
+    picks = sorted({1, len(rows) // 2, len(rows)})
+    out = [
+        "| iteration | population mean reward | best | worst | best_seed |",
+        "|---|---|---|---|---|",
+    ]
+    for i in picks:
+        r = rows[i - 1]
+        out.append(
+            f"| {i} | {fmt(r['reward'])} | {fmt(r.get('reward_best'))} | "
+            f"{fmt(r.get('reward_worst'))} | {int(r.get('best_seed', -1))} |"
+        )
+    return "\n".join(out)
+
+
+def plain_table(rows: list[dict]) -> str:
+    picks = sorted({1, len(rows) // 2, len(rows)})
+    out = ["| iteration | step | reward | avg_dist_to_goal |", "|---|---|---|---|"]
+    for i in picks:
+        r = rows[i - 1]
+        out.append(
+            f"| {i} | {int(r.get('step', 0))} | {fmt(r['reward'])} | "
+            f"{fmt(r['avg_dist_to_goal'], 1)} |"
+        )
+    return "\n".join(out)
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        raise SystemExit(__doc__)
+    path = Path(sys.argv[1])
+    rows = load(path)
+    last = rows[-1]
+    if any("curriculum_stage" in r for r in rows):
+        kind, table = "curriculum", curriculum_table(rows)
+    elif any("reward_best" in r for r in rows):
+        kind, table = "sweep", sweep_table(rows)
+    else:
+        kind, table = "single", plain_table(rows)
+    print(f"<!-- {kind} summary of {path} ({len(rows)} iterations, "
+          f"final step {int(last.get('step', 0))}) -->")
+    print(table)
+    env_rate = last.get("env_steps_per_sec")
+    if env_rate:
+        print(f"\nFinal training throughput: "
+              f"{env_rate:,.0f} formation-steps/s.")
+
+
+if __name__ == "__main__":
+    main()
