@@ -128,11 +128,14 @@ stage knn_big 420 knn_big_stage
 
 # -- 3. full bench (incl. the knn_big pallas phase) ---------------------
 bench_stage() {
-  BENCH_BUDGET_S=420 python bench.py | tail -1 > /tmp/bench_tpu.json || return 1
+  BENCH_BUDGET_S=540 python bench.py | tail -1 > /tmp/bench_tpu.json || return 1
   cat /tmp/bench_tpu.json
   # Hardware evidence only: refuse to stamp a fallback line, an errored
   # run (e.g. bench.py's own watchdog fired mid-hang — it still emits a
-  # JSON line, with an "error" field and value 0), or a zero headline.
+  # JSON line, with an "error" field and value 0), a zero headline, OR a
+  # phase-incomplete run (bench.py degrades over-deadline phases into
+  # "... skipped"/"... failed" notes — mirroring such a line would
+  # enshrine a partial run as the round's record; retry next window).
   python - <<'EOF' || return 1
 import json
 rec = json.load(open("/tmp/bench_tpu.json"))
@@ -140,11 +143,21 @@ assert not rec.get("fallback"), "bench fell back to CPU"
 assert rec.get("platform") != "cpu", rec.get("platform")
 assert "error" not in rec, rec.get("error")
 assert float(rec.get("value", 0.0)) > 0.0, "zero headline rate"
+notes = rec.get("notes", "")
+assert "skipped" not in notes and "failed" not in notes, notes
+for field in (
+    "train_env_steps_per_sec",
+    "train_env_steps_per_sec_tuned",
+    "train_env_steps_per_sec_tuned_fused",
+    "knn_env_steps_per_sec",
+    "knn_big_env_steps_per_sec",
+):
+    assert float(rec.get(field, 0.0)) > 0.0, f"missing phase: {field}"
 EOF
   python scripts/mirror_bench.py /tmp/bench_tpu.json docs/acceptance/tpu_bench_r4.md
 }
 export -f bench_stage
-stage bench 600 bench_stage
+stage bench 720 bench_stage
 
 # -- 4. remaining all-paths smoke (per-path stamps) ---------------------
 smoke_stage() {
