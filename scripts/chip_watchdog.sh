@@ -12,7 +12,7 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-POLL_S=${POLL_S:-120}
+POLL_S=${POLL_S:-60}
 MAX_LIFE_S=${MAX_LIFE_S:-39600}  # 11h
 STATE=/tmp/chip_state
 start=$(date +%s)

@@ -37,12 +37,15 @@ if [ "${CHIP_WINDOW_LOCKED:-}" != 1 ]; then
 fi
 
 probe() {
+  # 45s timeout: an up tunnel answers a device query in ~5-10s; waiting
+  # the old 90s on a down tunnel burned half the detection cadence and
+  # windows last only minutes.
   python - <<'EOF'
 import subprocess, sys
 try:
     out = subprocess.run(
         [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-        capture_output=True, text=True, timeout=90,
+        capture_output=True, text=True, timeout=45,
     )
 except subprocess.TimeoutExpired:
     sys.exit(1)
