@@ -53,6 +53,7 @@ platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
 sys.exit(0 if platform and platform != "cpu" else 1)
 EOF
 }
+export -f probe  # smoke_stage re-probes between paths under bash -c
 
 # stage <name> <timeout_s> <fn>: skip if stamped; run the exported shell
 # function under timeout (timeout(1) can't exec a function, so it goes
@@ -168,7 +169,7 @@ smoke_stage() {
   # One process + stamp PER PATH, so a tunnel drop mid-path keeps every
   # earlier pass (a single batched run would lose all its stamps when
   # the stage timeout kills the wrapper before the stamping loop).
-  local paths
+  local paths bad=0
   paths=$(python scripts/tpu_smoke.py --list) || return 1
   [ -n "$paths" ] || return 1  # an empty list must never stamp success
   for p in $paths; do
@@ -179,10 +180,14 @@ smoke_stage() {
       grep "SMOKE_OK: $p " /tmp/smoke_out.txt \
         | sed "s/^/$(date -u +%Y-%m-%dT%H:%M:%SZ) /" >> docs/acceptance/tpu_smoke.txt
     else
-      return 1
+      # One slow/failing path must not starve the rest — but if the
+      # tunnel itself dropped, every further path would just burn its
+      # timeout, so bail to the stage-level re-probe in that case.
+      bad=1
+      probe || return 1
     fi
   done
-  return 0
+  return $bad
 }
 export -f smoke_stage
 stage smoke 3000 smoke_stage
