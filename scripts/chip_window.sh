@@ -20,7 +20,7 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-STATE=/tmp/chip_state
+STATE=${CHIP_STATE_DIR:-/tmp/chip_state}
 export STATE  # stage functions run under `bash -c` and read it
 mkdir -p "$STATE" docs/acceptance
 
@@ -33,10 +33,16 @@ mkdir -p "$STATE" docs/acceptance
 # means "another run holds the lock".
 if [ "${CHIP_WINDOW_LOCKED:-}" != 1 ]; then
   export CHIP_WINDOW_LOCKED=1
-  exec flock -n -E 73 /tmp/chip_window.lock bash "$0" "$@"
+  exec flock -n -E 73 "${CHIP_LOCK_FILE:-/tmp/chip_window.lock}" bash "$0" "$@"
 fi
 
 probe() {
+  # Test hook: CHIP_PROBE_CMD replaces the device probe so the
+  # orchestration (stamps, resume, sentinel) is testable off-chip.
+  if [ -n "${CHIP_PROBE_CMD:-}" ]; then
+    eval "$CHIP_PROBE_CMD"
+    return $?
+  fi
   # 45s timeout: an up tunnel answers a device query in ~5-10s; waiting
   # the old 90s on a down tunnel burned half the detection cadence and
   # windows last only minutes.

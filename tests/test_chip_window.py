@@ -1,0 +1,102 @@
+"""Orchestration tests for the chip-window burster (scripts/chip_window.sh).
+
+The burster carries the round's hardware-evidence workflow (stamp-based
+resume across short tunnel windows); its logic must hold without a chip.
+``CHIP_PROBE_CMD`` substitutes the device probe and ``CHIP_STATE_DIR`` /
+``CHIP_LOCK_FILE`` isolate the run from a live watchdog, so these pin:
+
+- tunnel-down => clean exit before any stage;
+- all stages pre-stamped + tunnel up => ALL_DONE sentinel written and no
+  stage re-runs (resume semantics);
+- lock contention => exit 73 without touching state.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "chip_window.sh"
+
+# Stage names as chip_window.sh defines them, plus the per-path smoke
+# stamps derived from tpu_smoke.py --list.
+STAGES = [
+    "parity", "knn_big", "bench", "smoke", "profile", "tuning",
+    "sweep_bench", "hetero5", "sweep8",
+]
+
+
+def run_burster(tmp_path, probe_cmd: str, timeout: int = 120):
+    env = {
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "HOME": str(tmp_path),
+        "CHIP_PROBE_CMD": probe_cmd,
+        "CHIP_STATE_DIR": str(tmp_path / "state"),
+        "CHIP_LOCK_FILE": str(tmp_path / "lock"),
+    }
+    return subprocess.run(
+        ["bash", str(SCRIPT)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO,
+    )
+
+
+def smoke_paths() -> list[str]:
+    out = subprocess.run(
+        ["python", str(REPO / "scripts" / "tpu_smoke.py"), "--list"],
+        capture_output=True, text=True, check=True, cwd=REPO,
+    )
+    return out.stdout.split()
+
+
+def test_tunnel_down_exits_before_any_stage(tmp_path):
+    res = run_burster(tmp_path, "false")
+    assert res.returncode == 0, res.stderr
+    assert "tunnel down, nothing to do" in res.stdout
+    assert "== stage" not in res.stdout
+    state = tmp_path / "state"
+    assert not any(state.iterdir()), list(state.iterdir())
+
+
+def test_all_stamped_resumes_to_all_done(tmp_path):
+    state = tmp_path / "state"
+    state.mkdir()
+    for s in STAGES:
+        (state / s).touch()
+    for p in smoke_paths():
+        (state / f"smoke_{p}").touch()
+    res = run_burster(tmp_path, "true")
+    assert res.returncode == 0, res.stderr
+    # Every stage was stamped => nothing runs, sentinel appears.
+    assert "== stage" not in res.stdout
+    assert "ALL stages stamped" in res.stdout
+    assert (state / "ALL_DONE").exists()
+
+
+def test_stage_list_in_sync_with_script():
+    """STAGES above must match the stage() calls in the script — the
+    same no-drifting-copy rule the script enforces for smoke paths."""
+    text = SCRIPT.read_text()
+    import re
+
+    called = re.findall(r"^stage (\w+) ", text, re.MULTILINE)
+    assert called == STAGES, (called, STAGES)
+
+
+def test_lock_contention_exits_73(tmp_path):
+    lock = tmp_path / "lock"
+    holder = subprocess.Popen(
+        ["flock", str(lock), "-c", "sleep 30"],
+    )
+    try:
+        import time
+
+        time.sleep(0.5)
+        res = run_burster(tmp_path, "true")
+        assert res.returncode == 73, (res.returncode, res.stdout, res.stderr)
+        state = tmp_path / "state"
+        assert not (state / "ALL_DONE").exists()
+    finally:
+        holder.kill()
+        holder.wait()
