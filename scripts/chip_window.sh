@@ -211,7 +211,7 @@ tuning_stage() {
   grep -q '"metric"' /tmp/tuning_out.txt
 }
 export -f tuning_stage
-stage tuning 900 tuning_stage
+stage tuning 1200 tuning_stage
 
 # -- 7. population sweep amortization -----------------------------------
 sweep_bench_stage() {

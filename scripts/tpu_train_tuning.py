@@ -12,8 +12,10 @@ throughput win if its evaluation reward still beats the baseline by at
 least GUARD x the preset point's margin — faster-but-dumber batches are
 flagged, not crowned.
 
-Usage (chip window; CPU works for a self-smoke at tiny sizes):
-    python scripts/tpu_train_tuning.py [M] [iters]
+Usage (chip window; `cpu` forces the CPU backend for a self-smoke at
+tiny sizes — the env var route does not beat this image's eagerly
+registered device plugin):
+    python scripts/tpu_train_tuning.py [M] [iters] [cpu]
     TUNE_POINTS="8192:1e-3,16384:1.4e-3" python scripts/tpu_train_tuning.py
 
 Prints a table + one JSON line; mirror into docs/profiling.md when run
@@ -35,13 +37,22 @@ GUARD = 0.9  # eval margin-over-baseline must stay within 10% of preset's
 
 def default_points():
     # lr scaling: sqrt(batch / 8192) on the preset rate 1e-3 — plus an
-    # unscaled control per batch so the lr effect is separable.
+    # unscaled control per batch so the lr effect is separable. batch 0
+    # means "the full rollout buffer" (ONE minibatch per epoch): the
+    # profiling breakdown attributes the tuned iteration to the
+    # sequential minibatch chain, and the full-buffer point measures the
+    # per-minibatch overhead floor directly — if throughput scales with
+    # the step-count reduction, the chain is overhead-bound and a fused
+    # update kernel (or bigger batches) is the next lever; if not, it is
+    # compute/bandwidth-bound and batch size is done as a lever.
     return [
         (8192, 1.0e-3),
         (16384, 1.0e-3),
         (16384, 1.4e-3),
         (32768, 1.0e-3),
         (32768, 2.0e-3),
+        (0, 1.0e-3),
+        (0, 5.0e-3),  # sqrt-scaled for the 25x batch jump at M=4096
     ]
 
 
@@ -53,8 +64,9 @@ def parse_points(spec: str):
 
 
 def main() -> None:
-    m = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 120
+    args = [a for a in sys.argv[1:] if a != "cpu"]
+    m = int(args[0]) if len(args) > 0 else 4096
+    iters = int(args[1]) if len(args) > 1 else 120
     points = (
         parse_points(os.environ["TUNE_POINTS"])
         if "TUNE_POINTS" in os.environ
@@ -62,6 +74,9 @@ def main() -> None:
     )
 
     import jax
+
+    if "cpu" in sys.argv[1:]:
+        jax.config.update("jax_platforms", "cpu")
 
     from marl_distributedformation_tpu.algo import PPOConfig
     from marl_distributedformation_tpu.env import EnvParams
@@ -86,6 +101,9 @@ def main() -> None:
 
     rows = []
     for batch, lr in points:
+        buffer_size = PPOConfig().n_steps * m * params.num_agents
+        if batch == 0:
+            batch = buffer_size  # full buffer: one minibatch per epoch
         ppo = PPOConfig(batch_size=batch, learning_rate=lr)
         trainer = Trainer(
             params,
@@ -113,6 +131,7 @@ def main() -> None:
         rows.append(
             {
                 "batch_size": batch,
+                "minibatches_per_epoch": max(1, buffer_size // batch),
                 "learning_rate": lr,
                 "train_steps_per_sec": round(rate, 1),
                 "eval_return": round(ev["episode_return_per_agent"], 3),
