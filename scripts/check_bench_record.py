@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Validate a bench JSON line as committable chip evidence.
+
+The chip-window burster stamps a stage only when its bench record is
+real hardware evidence. Each stage needs the same gate — no CPU
+fallback, no watchdog error, no degraded ("skipped"/"failed") phases —
+plus a per-stage list of required rate fields. This is that gate in ONE
+place, so the acceptance criteria cannot drift between stages:
+
+    python scripts/check_bench_record.py /tmp/bench_tpu.json \
+        --require train_env_steps_per_sec knn_env_steps_per_sec \
+        --expect knn_impl=pallas
+
+Exit 0 iff the record passes. ``--require F`` asserts float(rec[F]) > 0;
+``--expect K=V`` asserts str(rec[K]) == V. Input parsing is shared with
+scripts/mirror_bench.py (bench.py stdout or a driver BENCH_r*.json
+wrapper), so the gate and the mirror can never disagree on a file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from mirror_bench import _load_record as load_record  # noqa: E402
+
+
+def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
+    """Return the list of violations (empty = evidence-grade record)."""
+    problems = []
+    if rec.get("fallback"):
+        problems.append("fallback: true — CPU run, not hardware evidence")
+    if rec.get("platform") == "cpu":
+        problems.append("platform is cpu")
+    if "error" in rec:
+        problems.append(f"error field present: {rec['error']!r}")
+    notes = str(rec.get("notes", ""))
+    if "skipped" in notes or "failed" in notes:
+        problems.append(f"degraded phases in notes: {notes!r}")
+    for field in require:
+        try:
+            ok = float(rec.get(field, 0.0)) > 0.0
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            problems.append(f"required field missing/zero: {field}")
+    for pair in expect:
+        key, _, want = pair.partition("=")
+        got = rec.get(key)
+        if str(got) != want:
+            problems.append(f"{key}={got!r}, expected {want!r}")
+    return problems
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("file", type=Path)
+    ap.add_argument("--require", nargs="*", default=[], metavar="FIELD")
+    ap.add_argument("--expect", nargs="*", default=[], metavar="KEY=VALUE")
+    args = ap.parse_args()
+    problems = check(load_record(args.file), args.require, args.expect)
+    for p in problems:
+        print(f"[check_bench_record] REJECT: {p}", file=sys.stderr)
+    if problems:
+        sys.exit(1)
+    print(f"[check_bench_record] OK: {args.file}")
+
+
+if __name__ == "__main__":
+    main()

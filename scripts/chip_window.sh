@@ -86,8 +86,18 @@ stage() {
 # Never contend with a foreign bench run for the single chip (the round
 # driver runs `python bench.py` for the official record; two processes
 # on one TPU skew both). Our own bench children run only while the lock
-# is held, i.e. after this check.
-if pgrep -f "python bench.py" >/dev/null 2>&1; then
+# is held, i.e. after this check. CHIP_FOREIGN_BENCH_CMD substitutes the
+# check for tests (like CHIP_PROBE_CMD) — otherwise a live watchdog's
+# bench child makes the orchestration tests flaky, and vice versa a
+# test-suite bench subprocess defers a real window.
+foreign_bench() {
+  if [ -n "${CHIP_FOREIGN_BENCH_CMD:-}" ]; then
+    eval "$CHIP_FOREIGN_BENCH_CMD"
+    return $?
+  fi
+  pgrep -f "python bench.py" >/dev/null 2>&1
+}
+if foreign_bench; then
   echo "foreign bench.py run in progress; deferring this window"
   exit 0
 fi
@@ -119,52 +129,76 @@ stage parity 600 parity_stage
 # hardware (N=1024 chunked Pallas kernel past the VMEM cliff). A short
 # window must be able to secure it without finishing the full bench. ----
 knn_big_stage() {
-  BENCH_SKIP_TRAIN=1 BENCH_SKIP_KNN=1 BENCH_BUDGET_S=300 python bench.py \
-    | tail -1 > /tmp/bench_knn_big.json || return 1
+  # SKIP_ENV_MAX: the shared gate rejects ANY failed/skipped phase note,
+  # so don't run phases this stage doesn't require (env_max lands in the
+  # full-bench record instead). `cmd` is defined ONCE and both executed
+  # and recorded, so the mirror's stated command cannot drift from the
+  # run (same pattern in every stage below).
+  local cmd="BENCH_SKIP_TRAIN=1 BENCH_SKIP_KNN=1 BENCH_SKIP_ENV_MAX=1 BENCH_BUDGET_S=300 python bench.py"
+  eval "$cmd" | tail -1 > /tmp/bench_knn_big.json || return 1
   cat /tmp/bench_knn_big.json
-  python - <<'EOF' || return 1
-import json
-rec = json.load(open("/tmp/bench_knn_big.json"))
-assert not rec.get("fallback"), "fell back to CPU"
-assert "error" not in rec, rec.get("error")
-assert rec.get("knn_big_impl") == "pallas_big", rec.get("knn_big_impl")
-assert float(rec.get("knn_big_env_steps_per_sec", 0.0)) > 0.0
-EOF
+  python scripts/check_bench_record.py /tmp/bench_knn_big.json \
+      --require knn_big_env_steps_per_sec \
+      --expect knn_big_impl=pallas_big || return 1
   python scripts/mirror_bench.py /tmp/bench_knn_big.json \
-      docs/acceptance/tpu_knn_big_r4.md
+      docs/acceptance/tpu_knn_big_r4.md --command "$cmd"
 }
 export -f knn_big_stage
 stage knn_big 420 knn_big_stage
 
+# -- 3a. train phases alone (parity + tuned + fused) — the fused number
+# has never been measured on hardware. The full bench is a ~10-minute
+# monolith (round-4 window 1 died inside it when the tunnel dropped);
+# these per-phase runs each fit a short window, so every window banks a
+# complete dated record for SOME phase group even if a long window never
+# shows. The monolithic stage below remains the clean single-run record.
+bench_train_stage() {
+  local cmd="BENCH_SKIP_KNN=1 BENCH_SKIP_KNN_BIG=1 BENCH_SKIP_ENV_MAX=1 BENCH_BUDGET_S=420 python bench.py"
+  eval "$cmd" | tail -1 > /tmp/bench_train.json || return 1
+  cat /tmp/bench_train.json
+  python scripts/check_bench_record.py /tmp/bench_train.json \
+      --require train_env_steps_per_sec train_env_steps_per_sec_tuned \
+                train_env_steps_per_sec_tuned_fused || return 1
+  # NB: the mirror name must NOT match the tpu_bench_r*.md glob —
+  # bench.py's _latest_chip_bench_claim() treats those as FULL-bench
+  # records when composing the CPU-fallback replay pointer.
+  python scripts/mirror_bench.py /tmp/bench_train.json \
+      docs/acceptance/tpu_bench_train_r4.md --command "$cmd"
+}
+export -f bench_train_stage
+stage bench_train 600 bench_train_stage
+
+# -- 3b. knn N=100 phase alone (fused Pallas kernel at the GNN shape) ---
+bench_knn_stage() {
+  local cmd="BENCH_SKIP_TRAIN=1 BENCH_SKIP_KNN_BIG=1 BENCH_SKIP_ENV_MAX=1 BENCH_BUDGET_S=240 python bench.py"
+  eval "$cmd" | tail -1 > /tmp/bench_knn.json || return 1
+  cat /tmp/bench_knn.json
+  python scripts/check_bench_record.py /tmp/bench_knn.json \
+      --require knn_env_steps_per_sec --expect knn_impl=pallas || return 1
+  python scripts/mirror_bench.py /tmp/bench_knn.json \
+      docs/acceptance/tpu_bench_knn_r4.md --command "$cmd"
+}
+export -f bench_knn_stage
+stage bench_knn 420 bench_knn_stage
+
 # -- 3. full bench (incl. the knn_big pallas phase) ---------------------
 bench_stage() {
-  BENCH_BUDGET_S=540 python bench.py | tail -1 > /tmp/bench_tpu.json || return 1
+  local cmd="BENCH_BUDGET_S=540 python bench.py"
+  eval "$cmd" | tail -1 > /tmp/bench_tpu.json || return 1
   cat /tmp/bench_tpu.json
-  # Hardware evidence only: refuse to stamp a fallback line, an errored
-  # run (e.g. bench.py's own watchdog fired mid-hang — it still emits a
-  # JSON line, with an "error" field and value 0), a zero headline, OR a
-  # phase-incomplete run (bench.py degrades over-deadline phases into
-  # "... skipped"/"... failed" notes — mirroring such a line would
-  # enshrine a partial run as the round's record; retry next window).
-  python - <<'EOF' || return 1
-import json
-rec = json.load(open("/tmp/bench_tpu.json"))
-assert not rec.get("fallback"), "bench fell back to CPU"
-assert rec.get("platform") != "cpu", rec.get("platform")
-assert "error" not in rec, rec.get("error")
-assert float(rec.get("value", 0.0)) > 0.0, "zero headline rate"
-notes = rec.get("notes", "")
-assert "skipped" not in notes and "failed" not in notes, notes
-for field in (
-    "train_env_steps_per_sec",
-    "train_env_steps_per_sec_tuned",
-    "train_env_steps_per_sec_tuned_fused",
-    "knn_env_steps_per_sec",
-    "knn_big_env_steps_per_sec",
-):
-    assert float(rec.get(field, 0.0)) > 0.0, f"missing phase: {field}"
-EOF
-  python scripts/mirror_bench.py /tmp/bench_tpu.json docs/acceptance/tpu_bench_r4.md
+  # Hardware evidence only: scripts/check_bench_record.py refuses a
+  # fallback line, an errored run (e.g. bench.py's own watchdog fired
+  # mid-hang — it still emits a JSON line, with an "error" field and
+  # value 0), and a phase-incomplete run (bench.py degrades
+  # over-deadline phases into "... skipped"/"... failed" notes —
+  # mirroring such a line would enshrine a partial run as the round's
+  # record; retry next window).
+  python scripts/check_bench_record.py /tmp/bench_tpu.json \
+      --require value train_env_steps_per_sec train_env_steps_per_sec_tuned \
+                train_env_steps_per_sec_tuned_fused knn_env_steps_per_sec \
+                knn_big_env_steps_per_sec || return 1
+  python scripts/mirror_bench.py /tmp/bench_tpu.json \
+      docs/acceptance/tpu_bench_r4.md --command "$cmd"
 }
 export -f bench_stage
 stage bench 720 bench_stage

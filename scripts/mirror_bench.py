@@ -46,11 +46,21 @@ def _load_record(src: Path) -> dict:
 
 
 def main() -> None:
-    args = [a for a in sys.argv[1:] if a != "--allow-fallback"]
-    allow_fallback = "--allow-fallback" in sys.argv
-    if len(args) != 2:
-        raise SystemExit(__doc__)
-    src, dst = Path(args[0]), Path(args[1])
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("src", type=Path)
+    ap.add_argument("dst", type=Path)
+    ap.add_argument(
+        "--command",
+        default="python bench.py",
+        help="exact invocation to record (include BENCH_SKIP_* flags "
+        "for partial-phase runs)",
+    )
+    ap.add_argument("--allow-fallback", action="store_true")
+    args = ap.parse_args()
+    src, dst, command = args.src, args.dst, args.command
+    allow_fallback = args.allow_fallback
     rec = _load_record(src)
     fallback = bool(rec.get("fallback"))
     if fallback and not allow_fallback:
@@ -70,7 +80,7 @@ def main() -> None:
         "",
         f"- measured: {measured} (source file mtime)",
         f"- platform: {rec.get('platform')} | fallback: {fallback}",
-        f"- command: `python bench.py` (mirrored by scripts/mirror_bench.py)",
+        f"- command: `{command}` (mirrored by scripts/mirror_bench.py)",
         "",
         "| field | value |",
         "|---|---|",

@@ -22,8 +22,8 @@ SCRIPT = REPO / "scripts" / "chip_window.sh"
 # Stage names as chip_window.sh defines them, plus the per-path smoke
 # stamps derived from tpu_smoke.py --list.
 STAGES = [
-    "parity", "knn_big", "bench", "smoke", "profile", "tuning",
-    "sweep_bench", "hetero5", "sweep8",
+    "parity", "knn_big", "bench_train", "bench_knn", "bench", "smoke",
+    "profile", "tuning", "sweep_bench", "hetero5", "sweep8",
 ]
 
 
@@ -32,6 +32,9 @@ def run_burster(tmp_path, probe_cmd: str, timeout: int = 120):
         "PATH": "/usr/bin:/bin:/usr/local/bin",
         "HOME": str(tmp_path),
         "CHIP_PROBE_CMD": probe_cmd,
+        # A live watchdog's bench child (or another test's bench.py
+        # subprocess) must not defer THIS isolated run.
+        "CHIP_FOREIGN_BENCH_CMD": "false",
         "CHIP_STATE_DIR": str(tmp_path / "state"),
         "CHIP_LOCK_FILE": str(tmp_path / "lock"),
     }
@@ -100,3 +103,45 @@ def test_lock_contention_exits_73(tmp_path):
     finally:
         holder.kill()
         holder.wait()
+
+
+def test_check_bench_record_gates():
+    """The shared evidence gate (scripts/check_bench_record.py) rejects
+    fallback/error/degraded records and missing fields, passes clean ones."""
+    import sys
+
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        from check_bench_record import check
+    finally:
+        sys.path.pop(0)
+
+    clean = {
+        "metric": "m", "platform": "tpu", "value": 1.0,
+        "knn_impl": "pallas", "knn_env_steps_per_sec": 5.0,
+    }
+    assert check(clean, ["value", "knn_env_steps_per_sec"],
+                 ["knn_impl=pallas"]) == []
+    assert check({**clean, "fallback": True}, [], [])
+    assert check({**clean, "platform": "cpu"}, [], [])
+    assert check({**clean, "error": "watchdog"}, [], [])
+    assert check({**clean, "notes": "train phase skipped: deadline"}, [], [])
+    assert check({**clean, "notes": "knn phase failed: X"}, [], [])
+    assert check(clean, ["train_env_steps_per_sec"], [])  # absent field
+    assert check({**clean, "value": 0.0}, ["value"], [])  # zero rate
+    assert check(clean, [], ["knn_impl=xla"])  # impl mismatch
+
+
+def test_partial_mirror_names_dodge_replay_glob():
+    """Partial-phase mirrors must NOT match the docs/acceptance/
+    tpu_bench_r*.md glob bench.py's _latest_chip_bench_claim() reads as
+    FULL-bench records for the CPU-fallback replay pointer."""
+    text = SCRIPT.read_text()
+    import fnmatch
+    import re
+
+    mirrors = re.findall(r"docs/acceptance/(\S+\.md)", text)
+    assert mirrors, "burster no longer writes mirrors?"
+    full = [m for m in mirrors if fnmatch.fnmatch(m, "tpu_bench_r*.md")]
+    # Exactly the monolithic full-bench record may match the glob.
+    assert full == ["tpu_bench_r4.md"], full
