@@ -34,6 +34,22 @@ from marl_distributedformation_tpu.utils import (
 )
 
 
+def _resolved_backend() -> dict:
+    """What actually ran — an eval JSON banked as hardware evidence must
+    prove its backend from the record itself (cf. train.py's
+    ``_snapshot_config``; a tunnel drop silently falls back to CPU)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return {
+            "resolved_platform": dev.platform,
+            "resolved_device": dev.device_kind,
+        }
+    except Exception:  # noqa: BLE001 — provenance never kills an eval
+        return {}
+
+
 def main(argv=None) -> dict:
     cfg = load_config(sys.argv[1:] if argv is None else argv)
     setup_platform(cfg.get("platform"))
@@ -98,6 +114,7 @@ def main(argv=None) -> dict:
             rows["policy"]["episode_return_per_agent"]
             > rows["baseline"]["episode_return_per_agent"]
         ),
+        **_resolved_backend(),
     }
     print(json.dumps(result))
     return result
@@ -142,6 +159,7 @@ def eval_sweep(member_dirs, params, m: int, seed: int) -> dict:
         "best_return": rows[best][key],
         "baseline_return": rows["baseline"][key],
         "beats_baseline": bool(rows[best][key] > rows["baseline"][key]),
+        **_resolved_backend(),
     }
     print(json.dumps(result))
     return result

@@ -254,12 +254,49 @@ sweep_bench_stage() {
 export -f sweep_bench_stage
 stage sweep_bench 600 sweep_bench_stage
 
+# land_tpu_run <run_name> <dest_dir> <artifacts_line>: verify the run's
+# RESOLVED backend from its config snapshot (train.py _snapshot_config —
+# a silent CPU fallback mid-window must never be banked as hardware
+# acceptance evidence), then copy the learning curve and write the
+# TPU_RUN.md record. EVERY command is guarded: a partial landing must
+# fail the stage so the next window retries it rather than stamping a
+# half-written record as done.
+land_tpu_run() {
+  local name="$1" dest="$2" artifacts="$3" device summary
+  device=$(python - "$name" <<'EOF'
+import json, sys
+snap = json.load(open(f"logs/{sys.argv[1]}/config.json"))
+got = snap.get("resolved_platform")
+assert got == "tpu", f"run executed on {got!r}, not tpu"
+print(snap.get("resolved_device"))
+EOF
+  ) || return 1
+  cp "logs/$name/metrics.jsonl" "$dest/metrics_tpu.jsonl" || return 1
+  summary=$(python scripts/summarize_acceptance.py \
+      "logs/$name/metrics.jsonl") || return 1
+  {
+    echo "# TPU hardware run (landed by scripts/chip_window.sh, run name: $name)"
+    echo
+    echo "- date: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    echo "- device: $device"
+    echo "- command: the CPU record's command without platform=cpu, name=$name (see chip_window.sh)"
+    echo "- artifacts: $artifacts"
+    echo
+    echo "$summary"
+  } > "$dest/TPU_RUN.md" || return 1
+  cat "$dest/TPU_RUN.md"
+}
+export -f land_tpu_run
+
 # -- 8. config-5 hetero curriculum acceptance on the chip ---------------
 hetero5_stage() {
   python train.py name=hetero5_tpu num_formation=64 \
     num_agents_per_formation=20 preset=tpu total_timesteps=1280000 \
     use_wandb=false \
-    "curriculum=[{rollouts: 30, agent_counts: [5]}, {rollouts: 40, agent_counts: [5, 20]}, {rollouts: 30, agent_counts: [5, 20], num_obstacles: 4}]"
+    "curriculum=[{rollouts: 30, agent_counts: [5]}, {rollouts: 40, agent_counts: [5, 20]}, {rollouts: 30, agent_counts: [5, 20], num_obstacles: 4}]" \
+    || return 1
+  land_tpu_run hetero5_tpu docs/acceptance/hetero5 \
+      "metrics_tpu.jsonl (full learning curve)"
 }
 export -f hetero5_stage
 stage hetero5 1800 hetero5_stage
@@ -272,7 +309,25 @@ sweep8_stage() {
     n_steps=16 batch_size=192 n_epochs=4 \
     total_timesteps=153600 save_freq=3200 use_wandb=false || return 1
   python evaluate.py name=sweep8_tpu num_formation=16 \
-    num_agents_per_formation=3 strict_parity=false max_steps=64
+    num_agents_per_formation=3 strict_parity=false max_steps=64 \
+    | tee /tmp/eval_sweep8.txt || return 1
+  tail -1 /tmp/eval_sweep8.txt > /tmp/eval_sweep8.json || return 1
+  # The eval is its own process: it must prove ITS backend too (the
+  # tunnel can drop between train and eval; evaluate.py stamps
+  # resolved_platform into its JSON line).
+  python - <<'EOF' || return 1
+import json
+rec = json.load(open("/tmp/eval_sweep8.json"))
+assert rec.get("sweep_members") == 8, rec
+assert "beats_baseline" in rec, rec
+assert rec.get("resolved_platform") == "tpu", rec.get("resolved_platform")
+EOF
+  cp logs/sweep8_tpu/sweep_summary.json \
+      docs/acceptance/sweep8/sweep_summary_tpu.json || return 1
+  cp /tmp/eval_sweep8.json \
+      docs/acceptance/sweep8/eval_all_members_tpu.json || return 1
+  land_tpu_run sweep8_tpu docs/acceptance/sweep8 \
+      "metrics_tpu.jsonl, sweep_summary_tpu.json, eval_all_members_tpu.json (all 8 members vs baseline/zero on 1024 held-out formations)"
 }
 export -f sweep8_stage
 stage sweep8 1800 sweep8_stage

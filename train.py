@@ -202,8 +202,20 @@ def _snapshot_config(cfg, log_dir) -> None:
     path = Path(log_dir)
     path.mkdir(parents=True, exist_ok=True)
     name = "config_resume.json" if cfg.get("resume") else "config.json"
+    snap = dict(cfg)
+    # The requested config says what the user asked for; these say what
+    # actually ran — an acceptance record claiming "TPU" must be able to
+    # prove it from the run directory (e.g. after a silent CPU fallback).
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        snap["resolved_platform"] = dev.platform
+        snap["resolved_device"] = dev.device_kind
+    except Exception:  # noqa: BLE001 — a snapshot never kills a run
+        pass
     with open(path / name, "w") as f:
-        json.dump(dict(cfg), f, indent=2, default=str)
+        json.dump(snap, f, indent=2, default=str)
 
 
 def main(argv=None) -> None:
