@@ -21,9 +21,12 @@ SCRIPT = REPO / "scripts" / "chip_window.sh"
 
 # Stage names as chip_window.sh defines them, plus the per-path smoke
 # stamps derived from tpu_smoke.py --list.
+# The monolithic full bench runs LAST: all its numbers are banked by the
+# partial stages, and it must not starve the unique-evidence stages by
+# retrying at the head of every short window.
 STAGES = [
-    "parity", "knn_big", "bench_train", "bench_knn", "bench", "smoke",
-    "profile", "tuning", "sweep_bench", "hetero5", "sweep8",
+    "parity", "knn_big", "bench_train", "bench_knn", "smoke",
+    "profile", "tuning", "sweep_bench", "hetero5", "sweep8", "bench",
 ]
 
 
