@@ -23,6 +23,10 @@ cd "$(dirname "$0")/.."
 STATE=${CHIP_STATE_DIR:-/tmp/chip_state}
 export STATE  # stage functions run under `bash -c` and read it
 mkdir -p "$STATE" docs/acceptance
+# A stage timeout can kill a banking helper mid-write; its atomic-rename
+# `.tmp` then survives in the tracked acceptance dir. Sweep them here so
+# a killed run can't leave a truncated pseudo-artifact for `git add`.
+rm -f docs/acceptance/*.tmp
 
 # The burster owns the single chip and the shared /tmp artifacts: one
 # instance at a time, whether fired by the watchdog or by hand. The lock
@@ -217,17 +221,48 @@ profile_stage() {
 export -f profile_stage
 stage profile 600 profile_stage
 
+# bank_txt_artifact <captured_out> <dest> <title> <cmd>: land a script's
+# teed stdout as a dated acceptance record. Atomic tmp+mv (same reason as
+# parity_stage: the stage timeout can kill us mid-write, and a truncating
+# `>` would destroy the previously-banked valid artifact).
+bank_txt_artifact() {
+  local src="$1" dest="$2" title="$3" cmd="$4"
+  # Provenance gate: both scripts stamp the backend they actually ran on
+  # into their summary JSON ("device": "TPU v5 lite" / "cpu"). A silent
+  # mid-window CPU fallback must never be banked as chip evidence (same
+  # rule check_bench_record.py / land_tpu_run enforce for their stages).
+  grep -q '"device": "TPU' "$src" || return 1
+  { echo "# $title"
+    echo "# date: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    echo "# command: $cmd"
+    grep -v WARNING "$src"
+  } > "$dest.tmp" || { rm -f "$dest.tmp"; return 1; }
+  mv "$dest.tmp" "$dest"
+}
+export -f bank_txt_artifact
+
 # -- 6. big-batch tuning (lr scaling + eval quality guard) --------------
 tuning_stage() {
-  python scripts/tpu_train_tuning.py 4096 120 | tee /tmp/tuning_out.txt
-  grep -q '"metric"' /tmp/tuning_out.txt
+  local cmd="python scripts/tpu_train_tuning.py 4096 120"
+  eval "$cmd" | tee /tmp/tuning_out.txt || return 1
+  # The summary JSON has no "metric" field (the old grep failed a GOOD
+  # run); key on a NON-NULL sweep verdict — `"best_quality_ok": null`
+  # means every point failed the eval quality guard and must not stamp.
+  grep -q '"best_quality_ok": {' /tmp/tuning_out.txt || return 1
+  bank_txt_artifact /tmp/tuning_out.txt docs/acceptance/tpu_tuning_r4.txt \
+      "Big-batch tuning sweep — TPU v5 lite" "$cmd"
 }
 export -f tuning_stage
 stage tuning 1200 tuning_stage
 
 # -- 7. population sweep amortization -----------------------------------
 sweep_bench_stage() {
-  python scripts/tpu_sweep_bench.py 8 512 | tee /tmp/sweep_bench_out.txt
+  local cmd="python scripts/tpu_sweep_bench.py 8 512"
+  eval "$cmd" | tee /tmp/sweep_bench_out.txt || return 1
+  grep -q '"sweep_population_throughput"' /tmp/sweep_bench_out.txt || return 1
+  bank_txt_artifact /tmp/sweep_bench_out.txt \
+      docs/acceptance/tpu_sweep_bench_r4.txt \
+      "Population-sweep amortization bench — TPU v5 lite" "$cmd"
 }
 export -f sweep_bench_stage
 stage sweep_bench 600 sweep_bench_stage
