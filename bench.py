@@ -425,15 +425,19 @@ def main() -> None:
         # Phase 2 — full PPO training iteration, at BOTH hyperparameter
         # points: the reference-parity config (SB3 batch_size=64 — tiny
         # sequential minibatches, the reference's own structure) and the
-        # TPU-tuned preset (batch_size=8192, same data, same epochs —
-        # utils/config.py PRESETS["tpu"]). vs_baseline for both uses the
+        # TPU-tuned preset (the REAL utils/config.py PRESETS["tpu"] batch —
+        # same data, same epochs). vs_baseline for both uses the
         # measured full-SB3-loop estimate, not env-stepping-only (see
         # REFERENCE_TRAIN_FORMATION_STEPS_PER_SEC).
         if os.environ.get("BENCH_SKIP_TRAIN") != "1":
             if time.time() < deadline - 30:
                 try:
                     from marl_distributedformation_tpu.algo import PPOConfig
+                    from marl_distributedformation_tpu.utils.config import (
+                        PRESETS,
+                    )
 
+                    tuned_batch = PRESETS["tpu"]["batch_size"]
                     train_m = _env_int(
                         "BENCH_TRAIN_M", M if on_accel else 256
                     )
@@ -459,7 +463,7 @@ def main() -> None:
                     )
                     tuned_rate, tuned_iters, _ = _time_train_phase(
                         N, train_m, deadline,
-                        ppo=PPOConfig(batch_size=8192),
+                        ppo=PPOConfig(batch_size=tuned_batch),
                     )
                     result["train_env_steps_per_sec_tuned"] = round(
                         tuned_rate, 1
@@ -467,13 +471,13 @@ def main() -> None:
                     result["train_iters_per_sec_tuned"] = round(
                         tuned_iters, 2
                     )
-                    result["train_tuned_batch_size"] = 8192
+                    result["train_tuned_batch_size"] = tuned_batch
                     result["train_tuned_vs_baseline"] = round(
                         tuned_rate / REFERENCE_TRAIN_FORMATION_STEPS_PER_SEC,
                         2,
                     )
                     print(
-                        f"[bench] train (preset=tpu, batch=8192): "
+                        f"[bench] train (preset=tpu, batch={tuned_batch}): "
                         f"{tuned_rate:,.0f} formation-steps/s "
                         f"({tuned_iters:.2f} iters/s)",
                         file=sys.stderr,
@@ -600,13 +604,18 @@ def main() -> None:
             elif time.time() < deadline - 30:
                 try:
                     from marl_distributedformation_tpu.algo import PPOConfig
+                    from marl_distributedformation_tpu.utils.config import (
+                        PRESETS,
+                    )
 
                     train_m = _env_int(
                         "BENCH_TRAIN_M", M if on_accel else 256
                     )
                     fused_rate, fused_iters, _ = _time_train_phase(
                         N, train_m, deadline,
-                        ppo=PPOConfig(batch_size=8192),
+                        ppo=PPOConfig(
+                            batch_size=PRESETS["tpu"]["batch_size"]
+                        ),
                         iters_per_dispatch=fused_r,
                     )
                     result["train_env_steps_per_sec_tuned_fused"] = round(

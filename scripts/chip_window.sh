@@ -22,12 +22,6 @@ cd "$(dirname "$0")/.."
 
 STATE=${CHIP_STATE_DIR:-/tmp/chip_state}
 export STATE  # stage functions run under `bash -c` and read it
-mkdir -p "$STATE" docs/acceptance
-# A stage timeout can kill a banking helper mid-write; its atomic-rename
-# `.tmp` then survives in the tracked acceptance dir. Sweep them here so
-# a killed run can't leave a truncated pseudo-artifact for `git add`.
-rm -f docs/acceptance/*.tmp
-
 # The burster owns the single chip and the shared /tmp artifacts: one
 # instance at a time, whether fired by the watchdog or by hand. The lock
 # lives HERE (not in the watchdog) so a manual run can't race a tick.
@@ -39,6 +33,14 @@ if [ "${CHIP_WINDOW_LOCKED:-}" != 1 ]; then
   export CHIP_WINDOW_LOCKED=1
   exec flock -n -E 73 "${CHIP_LOCK_FILE:-/tmp/chip_window.lock}" bash "$0" "$@"
 fi
+
+mkdir -p "$STATE" docs/acceptance
+# A stage timeout can kill a banking helper mid-write; its atomic-rename
+# `.tmp` then survives in the tracked acceptance dir. Sweep them so a
+# killed run can't leave a truncated pseudo-artifact for `git add`. MUST
+# stay below the flock gate: before it, a bounced-off concurrent tick
+# would delete the lock-holder's in-flight tmp mid-rename.
+rm -f docs/acceptance/*.tmp
 
 probe() {
   # Test hook: CHIP_PROBE_CMD replaces the device probe so the

@@ -27,12 +27,17 @@ def main() -> None:
     from marl_distributedformation_tpu.algo import PPOConfig
     from marl_distributedformation_tpu.env import EnvParams
     from marl_distributedformation_tpu.train import TrainConfig, Trainer
+    from marl_distributedformation_tpu.utils.config import PRESETS
 
     device = jax.devices()[0].device_kind
     rows = {}
+    tuned_batch = PRESETS["tpu"]["batch_size"]
     for label, ppo in (
         ("parity (batch=64)", PPOConfig()),
-        ("preset=tpu (batch=8192)", PPOConfig(batch_size=8192)),
+        (
+            f"preset=tpu (batch={tuned_batch})",
+            PPOConfig(batch_size=tuned_batch),
+        ),
     ):
         trainer = Trainer(
             EnvParams(num_agents=5),

@@ -53,8 +53,11 @@ def main() -> None:
         Trainer,
     )
 
+    from marl_distributedformation_tpu.utils.config import PRESETS
+
     device = jax.devices()[0].device_kind
-    ppo = PPOConfig(batch_size=8192)  # preset=tpu (docs/profiling.md)
+    # The REAL preset=tpu batch (docs/profiling.md), not a drifting copy.
+    ppo = PPOConfig(batch_size=PRESETS["tpu"]["batch_size"])
     env = EnvParams(num_agents=5)
 
     def cfg(name: str) -> TrainConfig:

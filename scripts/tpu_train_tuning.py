@@ -36,7 +36,7 @@ GUARD = 0.9  # eval margin-over-baseline must stay within 10% of preset's
 
 
 def default_points():
-    # lr scaling: sqrt(batch / 8192) on the preset rate 1e-3 — plus an
+    # lr scaling: sqrt(batch / 8192) on the base rate 1e-3 — plus an
     # unscaled control per batch so the lr effect is separable. batch 0
     # means "the full rollout buffer" (ONE minibatch per epoch): the
     # profiling breakdown attributes the tuned iteration to the
@@ -145,22 +145,29 @@ def main() -> None:
             file=sys.stderr,
         )
 
-    # Anchor the guard on the preset point explicitly; a custom
-    # TUNE_POINTS list without it falls back to its first row — say so,
-    # since quality_ok then means "vs that row", not "vs the preset".
+    # Anchor the guard on the REAL preset point (utils.config.PRESETS —
+    # not a drifting copy); a custom TUNE_POINTS list without it falls
+    # back to its first row — say so, since quality_ok then means "vs
+    # that row", not "vs the preset".
+    from marl_distributedformation_tpu.utils.config import PRESETS
+
+    preset_batch = PRESETS["tpu"]["batch_size"]
     anchor = next(
         (
             r for r in rows
-            if r["batch_size"] == 8192 and r["learning_rate"] == 1.0e-3
+            if r["batch_size"] == preset_batch
+            and r["learning_rate"] == 1.0e-3
         ),
         rows[0],
     )
     if anchor is rows[0] and (
-        anchor["batch_size"] != 8192 or anchor["learning_rate"] != 1.0e-3
+        anchor["batch_size"] != preset_batch
+        or anchor["learning_rate"] != 1.0e-3
     ):
         print(
-            "[tune] note: preset point (8192, 1e-3) not in TUNE_POINTS; "
-            f"quality guard anchors on batch={anchor['batch_size']} "
+            f"[tune] note: preset point ({preset_batch}, 1e-3) not in "
+            f"TUNE_POINTS; quality guard anchors on "
+            f"batch={anchor['batch_size']} "
             f"lr={anchor['learning_rate']:g} instead",
             file=sys.stderr,
         )
