@@ -269,6 +269,20 @@ sweep_bench_stage() {
 export -f sweep_bench_stage
 stage sweep_bench 600 sweep_bench_stage
 
+# -- 7b. chunked k-NN kernel block-shape sweep --------------------------
+knn_big_tuning_stage() {
+  local cmd="python scripts/tpu_knn_big_tuning.py 512 1024 50"
+  eval "$cmd" | tee /tmp/knn_big_tuning_out.txt || return 1
+  # `"best": {` is null when no candidate was bit-exact vs XLA — that is
+  # a kernel bug, not a tuning result; never stamp it.
+  grep -q '"best": {' /tmp/knn_big_tuning_out.txt || return 1
+  bank_txt_artifact /tmp/knn_big_tuning_out.txt \
+      docs/acceptance/tpu_knn_big_tuning_r4.txt \
+      "Chunked k-NN kernel block-shape sweep — TPU v5 lite" "$cmd"
+}
+export -f knn_big_tuning_stage
+stage knn_big_tuning 900 knn_big_tuning_stage
+
 # land_tpu_run <run_name> <dest_dir> <artifacts_line>: verify the run's
 # RESOLVED backend from its config snapshot (train.py _snapshot_config —
 # a silent CPU fallback mid-window must never be banked as hardware
@@ -386,4 +400,9 @@ done
 if [ "$done" -eq 1 ]; then
   touch "$STATE/ALL_DONE"
   echo "== ALL stages stamped =="
+else
+  # A grown stage list (or a deliberately un-stamped stage) must reopen
+  # the queue: a stale ALL_DONE would short-circuit every watchdog tick
+  # and the new stage would silently never run.
+  rm -f "$STATE/ALL_DONE"
 fi

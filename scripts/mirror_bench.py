@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import datetime
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -99,7 +100,12 @@ def main() -> None:
         "",
     ]
     dst.parent.mkdir(parents=True, exist_ok=True)
-    dst.write_text("\n".join(lines))
+    # Atomic tmp+rename: a stage timeout killing us mid-write must never
+    # truncate a previously-banked record (same rule as bank_txt_artifact
+    # and parity_stage in chip_window.sh; the burster sweeps stale .tmp).
+    tmp = dst.with_suffix(dst.suffix + ".tmp")
+    tmp.write_text("\n".join(lines))
+    os.replace(tmp, dst)
     print(f"[mirror_bench] wrote {dst} ({len(rec)} fields)")
 
 

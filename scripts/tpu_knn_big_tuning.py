@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Chip experiment: sweep the chunked-streaming k-NN kernel's block shape.
+
+``knn_batch_pallas_big`` (ops/knn_pallas.py) ships with defaults
+``block_r=256, chunk_c=512, block_m=1`` that were chosen analytically
+(~3 MB of VMEM tile intermediates per program), never measured against
+alternatives on hardware. This sweeps a small grid of lane-aligned block
+shapes at the bench shape (M=512, N=1024, k=4 — the `knn_big` bench
+phase), checks each candidate's indices bit-match the XLA path (the
+kernel's contract), and times the compiled call.
+
+Every candidate that compiles is recorded; Mosaic rejections (VMEM
+overflow for fat blocks) are recorded as failed so the sweep doubles as
+a map of the kernel's feasibility envelope on this chip generation.
+
+Run: python scripts/tpu_knn_big_tuning.py [M] [N] [iters]
+     TUNE_BLOCKS="256:512:1,128:512:8" overrides the candidate list
+     (block_r:chunk_c:block_m triples).
+Prints one table row per candidate + a summary JSON line (keyed
+``"metric": "knn_big_block_tuning"``; ``best`` = fastest bit-exact
+candidate).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def default_blocks():
+    # Around the shipped default (256, 512, 1): halve/double each axis
+    # independently, plus multi-formation programs (block_m > 1
+    # amortizes grid/dispatch overhead if VMEM allows — each program's
+    # intermediates scale linearly in block_m).
+    return [
+        (256, 512, 1),  # shipped default — the anchor
+        (128, 512, 1),
+        (512, 512, 1),
+        (256, 256, 1),
+        (256, 1024, 1),
+        (256, 512, 2),
+        (256, 512, 4),
+        (256, 512, 8),
+        (128, 256, 8),
+    ]
+
+
+def parse_blocks(spec: str):
+    return [
+        tuple(int(v) for v in p.split(":"))
+        for p in spec.split(",")
+        if p.strip()
+    ]
+
+
+def main() -> None:
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 50
+    k = 4
+    # Off-chip plumbing self-test: interpret-mode Pallas on tiny shapes
+    # (timings are meaningless there; the chip run never sets this).
+    interpret = os.environ.get("KNN_TUNE_INTERPRET") == "1"
+
+    import jax
+    import jax.numpy as jnp
+
+    from marl_distributedformation_tpu.ops.knn import knn_batch
+    from marl_distributedformation_tpu.ops.knn_pallas import (
+        knn_batch_pallas_big,
+    )
+
+    device = jax.devices()[0].device_kind
+    points = jax.random.uniform(
+        jax.random.PRNGKey(0), (m, n, 2), jnp.float32, 0.0, 800.0
+    )
+    ref_idx, ref_off, ref_dist = jax.block_until_ready(
+        knn_batch(points, k, impl="xla")
+    )
+
+    blocks = (
+        parse_blocks(os.environ["TUNE_BLOCKS"])
+        if os.environ.get("TUNE_BLOCKS")
+        else default_blocks()
+    )
+    rows = []
+    print(f"| block_r | chunk_c | block_m | us/call | bit-exact |")
+    print(f"|---|---|---|---|---|")
+    for block_r, chunk_c, block_m in blocks:
+        rec = {
+            "block_r": block_r,
+            "chunk_c": chunk_c,
+            "block_m": block_m,
+        }
+        try:
+            run = lambda: knn_batch_pallas_big(  # noqa: E731
+                points, k,
+                block_r=block_r, chunk_c=chunk_c, block_m=block_m,
+                interpret=interpret,
+            )
+            idx, off, dist = jax.block_until_ready(run())  # compile+warm
+            exact = bool(jnp.array_equal(idx, ref_idx)) and bool(
+                jnp.allclose(dist, ref_dist, atol=1e-4)
+            )
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = run()
+            jax.block_until_ready(out)
+            us = (time.perf_counter() - t0) / iters * 1e6
+            rec.update(us_per_call=round(us, 1), bit_exact=exact, ok=True)
+            print(
+                f"| {block_r} | {chunk_c} | {block_m} | {us:,.1f} |"
+                f" {exact} |"
+            )
+        except Exception as e:  # noqa: BLE001 — feasibility map, not crash
+            rec.update(ok=False, error=repr(e)[:160])
+            print(
+                f"| {block_r} | {chunk_c} | {block_m} | FAILED |"
+                f" {repr(e)[:60]} |"
+            )
+        rows.append(rec)
+
+    good = [r for r in rows if r.get("ok") and r.get("bit_exact")]
+    best = min(good, key=lambda r: r["us_per_call"]) if good else None
+    anchor = next(
+        (
+            r for r in good
+            if (r["block_r"], r["chunk_c"], r["block_m"]) == (256, 512, 1)
+        ),
+        None,
+    )
+    out = {
+        "metric": "knn_big_block_tuning",
+        "device": device,
+        "m": m,
+        "n": n,
+        "k": k,
+        "iters": iters,
+        "rows": rows,
+        "anchor_default": anchor,
+        "best": best,
+    }
+    if best and anchor:
+        out["best_speedup_vs_default"] = round(
+            anchor["us_per_call"] / best["us_per_call"], 3
+        )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,13 +26,15 @@ SCRIPT = REPO / "scripts" / "chip_window.sh"
 # retrying at the head of every short window.
 STAGES = [
     "parity", "knn_big", "bench_train", "bench_knn", "smoke",
-    "profile", "tuning", "sweep_bench", "hetero5", "sweep8", "bench",
+    "profile", "tuning", "sweep_bench", "knn_big_tuning",
+    "hetero5", "sweep8", "bench",
 ]
 
 
-def run_burster(tmp_path, probe_cmd: str, timeout: int = 120):
+def run_burster(tmp_path, probe_cmd: str, timeout: int = 120,
+                path: str = "/usr/bin:/bin:/usr/local/bin"):
     env = {
-        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "PATH": path,
         "HOME": str(tmp_path),
         "CHIP_PROBE_CMD": probe_cmd,
         # A live watchdog's bench child (or another test's bench.py
@@ -78,6 +80,29 @@ def test_all_stamped_resumes_to_all_done(tmp_path):
     assert "== stage" not in res.stdout
     assert "ALL stages stamped" in res.stdout
     assert (state / "ALL_DONE").exists()
+
+
+def test_unstamped_stage_reopens_stale_all_done(tmp_path):
+    """A grown stage list must clear a stale ALL_DONE sentinel —
+    otherwise the watchdog short-circuits every tick and a newly added
+    stage silently never runs. The unstamped stage is made to fail
+    instantly by stripping python from PATH (probe stays stubbed up),
+    so this pins the sentinel logic, not the stage itself."""
+    state = tmp_path / "state"
+    state.mkdir()
+    for s in STAGES:
+        (state / s).touch()
+    for p in smoke_paths():
+        (state / f"smoke_{p}").touch()
+    (state / "ALL_DONE").touch()
+    (state / "profile").unlink()  # the queue grew / a stamp was cleared
+    res = run_burster(tmp_path, "true", path="/usr/bin:/bin")
+    assert res.returncode == 0, res.stderr
+    assert "== stage profile " in res.stdout
+    assert "ALL stages stamped" not in res.stdout
+    assert not (state / "ALL_DONE").exists()
+    # The sentinel only reopens; banked stamps stay banked.
+    assert (state / "bench").exists()
 
 
 def test_stage_list_in_sync_with_script():
