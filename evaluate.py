@@ -57,6 +57,14 @@ def main(argv=None) -> dict:
     m = int(cfg.get("eval_formations", 1024))
     seed = int(cfg.get("eval_seed", 1234))
 
+    # eval_deterministic=false evaluates the policy as it behaves during
+    # training (actions sampled from its Gaussian) — SB3's
+    # evaluate_policy(deterministic=...) knob. Policies trained with a
+    # high entropy bonus can rely on their action noise; the mode action
+    # alone can misrepresent them (see docs/acceptance/hetero5/). Values
+    # arrive YAML-parsed, so plain truthiness is the repo convention.
+    det = bool(cfg.get("eval_deterministic", True))
+
     ckpt = cfg.get("checkpoint")
     if not ckpt:
         log_dir = repo_root() / "logs" / str(cfg.name)
@@ -74,7 +82,7 @@ def main(argv=None) -> dict:
             # Sweep run (train/sweep.py): rank EVERY member by held-out
             # evaluation on identical initial states — more principled
             # than sweep_summary.json's training-reward ranking.
-            return eval_sweep(member_dirs, params, m, seed)
+            return eval_sweep(member_dirs, params, m, seed, det)
         ckpt = latest_checkpoint(log_dir)
         if ckpt is None:
             raise SystemExit(
@@ -83,7 +91,7 @@ def main(argv=None) -> dict:
             )
 
     rows = {
-        "policy": evaluate_checkpoint(str(ckpt), params, m, seed),
+        "policy": evaluate_checkpoint(str(ckpt), params, m, seed, det),
         "baseline": evaluate(baseline_act_fn(params), params, m, seed),
         "zero": evaluate(zero_act_fn(), params, m, seed),
     }
@@ -109,6 +117,7 @@ def main(argv=None) -> dict:
         "eval_formations": m,
         "num_agents": params.num_agents,
         "seed": seed,
+        "eval_deterministic": det,
         **{f"{name}_{c}": r[c] for name, r in rows.items() for c in cols},
         "beats_baseline": bool(
             rows["policy"]["episode_return_per_agent"]
@@ -120,7 +129,9 @@ def main(argv=None) -> dict:
     return result
 
 
-def eval_sweep(member_dirs, params, m: int, seed: int) -> dict:
+def eval_sweep(
+    member_dirs, params, m: int, seed: int, deterministic: bool = True
+) -> dict:
     """Evaluate every sweep member's latest checkpoint plus the baseline
     and zero policies, all on the same initial states; print a ranked
     table and emit one JSON line."""
@@ -130,7 +141,9 @@ def eval_sweep(member_dirs, params, m: int, seed: int) -> dict:
         if ckpt is None:
             print(f"[eval] {d.name}: no checkpoint, skipping")
             continue
-        rows[d.name] = evaluate_checkpoint(str(ckpt), params, m, seed)
+        rows[d.name] = evaluate_checkpoint(
+            str(ckpt), params, m, seed, deterministic
+        )
     if not rows:
         raise SystemExit("no member checkpoints found under seed*/")
     rows["baseline"] = evaluate(baseline_act_fn(params), params, m, seed)
@@ -154,6 +167,7 @@ def eval_sweep(member_dirs, params, m: int, seed: int) -> dict:
         "eval_formations": m,
         "num_agents": params.num_agents,
         "seed": seed,
+        "eval_deterministic": deterministic,
         "member_returns": {n: rows[n][key] for n in members},
         "best_member": best,
         "best_return": rows[best][key],

@@ -31,9 +31,13 @@ from marl_distributedformation_tpu.env.formation import (
 
 Array = jax.Array
 
-# act_fn(agents (M,N,2), goal (M,2), obstacles (M,K,2), obs (M,N,obs_dim))
-#   -> velocities (M,N,2)  [RAW velocities — the L0 contract, SURVEY.md Q8]
-ActFn = Callable[[Array, Array, Array, Array], Array]
+# act_fn(agents (M,N,2), goal (M,2), obstacles (M,K,2), obs (M,N,obs_dim),
+#        key) -> velocities (M,N,2)  [RAW velocities — the L0 contract,
+# SURVEY.md Q8]. ``key`` is a fresh per-step PRNG key; deterministic
+# controllers ignore it, a stochastic policy samples with it (SB3's
+# ``evaluate_policy(deterministic=...)`` knob — some trained policies rely
+# on their action noise and behave differently under the mode action).
+ActFn = Callable[[Array, Array, Array, Array, Array], Array]
 
 
 def episode_length(params: EnvParams) -> int:
@@ -50,13 +54,19 @@ def episode_length(params: EnvParams) -> int:
 def _run_episodes(
     key: Array, act_fn: ActFn, params: EnvParams, num_formations: int
 ) -> Dict[str, Array]:
+    # Reset uses ``key`` unchanged (NOT a split): recorded eval artifacts
+    # compare controllers on identical initial states across runs, so the
+    # seed -> initial-state mapping must stay stable. The action-noise
+    # stream is folded off it.
+    act_key = jax.random.fold_in(key, 1)
     state = reset_batch(key, params, num_formations)
     obs0 = compute_obs(state.agents, state.goal, params)
     T = episode_length(params)
 
     def body(carry, _):
-        state, obs = carry
-        vel = act_fn(state.agents, state.goal, state.obstacles, obs)
+        state, obs, act_key = carry
+        act_key, k = jax.random.split(act_key)
+        vel = act_fn(state.agents, state.goal, state.obstacles, obs, k)
         state, tr = step_batch(state, vel, params)
         step_out = {
             "reward": tr.reward.mean(),  # mean over formations x agents
@@ -64,9 +74,9 @@ def _run_episodes(
             "ave_dist_to_neighbor": tr.metrics["ave_dist_to_neighbor"].mean(),
             "done": tr.done.sum(),
         }
-        return (state, tr.obs), step_out
+        return (state, tr.obs, act_key), step_out
 
-    (_, _), out = jax.lax.scan(body, (state, obs0), None, length=T)
+    (_, _, _), out = jax.lax.scan(body, (state, obs0, act_key), None, length=T)
     # The step where done fires auto-resets the state BEFORE metrics are
     # computed (the reference's step order, simulate.py:113-117), so the
     # scan's last row reports a fresh random formation. In BOTH parity and
@@ -105,8 +115,8 @@ def evaluate(
 def baseline_act_fn(params: EnvParams) -> ActFn:
     """The scripted potential-field controller as an ``ActFn``."""
 
-    def act(agents, goal, obstacles, obs):
-        del obs
+    def act(agents, goal, obstacles, obs, key):
+        del obs, key
         return jax.vmap(control, in_axes=(0, 0, 0, None))(
             agents, goal, obstacles, params
         )
@@ -117,22 +127,34 @@ def baseline_act_fn(params: EnvParams) -> ActFn:
 def policy_act_fn(
     model, model_params, params: EnvParams, deterministic: bool = True
 ) -> ActFn:
-    """A trained actor-critic as an ``ActFn``: mode action, clipped to the
-    [-1, 1] action space, scaled by max_speed (the L1 adapter semantics,
-    reference vectorized_env.py:69-70)."""
+    """A trained actor-critic as an ``ActFn``: the mode action by default,
+    or (``deterministic=False``) actions sampled from the policy's Gaussian
+    — SB3's ``evaluate_policy(deterministic=...)`` knob. Either way clipped
+    to the [-1, 1] action space and scaled by max_speed (the L1 adapter
+    semantics, reference vectorized_env.py:69-70).
+
+    The stochastic mode matters: a policy trained with a high entropy
+    bonus can RELY on its action noise (e.g. the hetero5 artifact holds
+    N=5 ring spacing only through noise — its mode action collapses the
+    formation, docs/acceptance/hetero5/), so the mode action alone can
+    misrepresent what the policy actually does during training."""
     per_formation = getattr(model, "per_formation", False)
 
-    def act(agents, goal, obstacles, obs):
+    def act(agents, goal, obstacles, obs, key):
         del agents, goal, obstacles
         m = obs.shape[0]
         if not per_formation:
             flat = obs.reshape(-1, obs.shape[-1])
-            mean, _, _ = model.apply(model_params, flat)
+            mean, log_std, _ = model.apply(model_params, flat)
             mean = mean.reshape(m, -1, mean.shape[-1])
         else:
-            mean, _, _ = model.apply(model_params, obs)
-        assert deterministic, "eval uses the deterministic mode action"
-        return params.max_speed * jnp.clip(mean, -1.0, 1.0)
+            mean, log_std, _ = model.apply(model_params, obs)
+        a = mean
+        if not deterministic:
+            from marl_distributedformation_tpu.models import distributions
+
+            a = distributions.sample(key, mean, log_std)
+        return params.max_speed * jnp.clip(a, -1.0, 1.0)
 
     return act
 
@@ -140,8 +162,8 @@ def policy_act_fn(
 def zero_act_fn() -> ActFn:
     """Do-nothing control — the floor any learned policy must clear."""
 
-    def act(agents, goal, obstacles, obs):
-        del goal, obstacles, obs
+    def act(agents, goal, obstacles, obs, key):
+        del goal, obstacles, obs, key
         return jnp.zeros_like(agents)
 
     return act
@@ -152,12 +174,14 @@ def evaluate_checkpoint(
     params: EnvParams,
     num_formations: int = 1024,
     seed: int = 1234,
+    deterministic: bool = True,
 ) -> Dict[str, float]:
-    """Restore a trainer checkpoint and evaluate its deterministic policy."""
+    """Restore a trainer checkpoint and evaluate its policy (mode action
+    by default; ``deterministic=False`` samples — see ``policy_act_fn``)."""
     from marl_distributedformation_tpu.compat.policy import LoadedPolicy
 
     pol = LoadedPolicy.from_checkpoint(
         checkpoint_path, act_dim=params.act_dim, env_params=params
     )
-    act = policy_act_fn(pol.model, pol.params, params)
+    act = policy_act_fn(pol.model, pol.params, params, deterministic)
     return evaluate(act, params, num_formations=num_formations, seed=seed)

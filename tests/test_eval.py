@@ -70,8 +70,33 @@ def test_policy_act_fn_scales_and_clips():
     params = short_params()
     act = policy_act_fn(HugeMean(), {}, params)
     obs = jnp.zeros((3, params.num_agents, params.obs_dim))
-    vel = act(None, None, None, obs)
+    vel = act(None, None, None, obs, jax.random.PRNGKey(0))
     np.testing.assert_allclose(np.asarray(vel), params.max_speed)
+
+
+def test_policy_act_fn_stochastic_samples():
+    """deterministic=False samples mean + exp(log_std)·eps (SB3's
+    evaluate_policy knob); the sample is key-driven and clipped before
+    max_speed scaling."""
+
+    class ZeroMeanWideStd:
+        per_formation = False
+
+        def apply(self, params, obs):
+            mean = jnp.zeros((obs.shape[0], 2))
+            return mean, jnp.full(2, -1.0), jnp.zeros(obs.shape[0])
+
+    params = short_params()
+    act = policy_act_fn(ZeroMeanWideStd(), {}, params, deterministic=False)
+    obs = jnp.zeros((3, params.num_agents, params.obs_dim))
+    v1 = act(None, None, None, obs, jax.random.PRNGKey(0))
+    v2 = act(None, None, None, obs, jax.random.PRNGKey(0))
+    v3 = act(None, None, None, obs, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))  # key-driven
+    assert np.abs(np.asarray(v1) - np.asarray(v3)).max() > 0  # varies by key
+    assert np.abs(np.asarray(v1)).max() <= params.max_speed  # clipped
+    # std = e^-1 ~ 0.37: samples are non-degenerate around the zero mean
+    assert np.abs(np.asarray(v1)).max() > 0
 
 
 def test_evaluate_cli_roundtrip(tmp_path, monkeypatch, capsys):
