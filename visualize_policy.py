@@ -100,12 +100,19 @@ def main(argv=None) -> None:
     headless = bool(cfg.get("headless", False))
     gif = cfg.get("gif")
     quiet = bool(gif)  # gif recording skips the per-step transition dump
+    # deterministic=false plays the policy as it behaves during training
+    # (actions sampled from its Gaussian — evaluate.py's
+    # eval_deterministic knob; noise-reliant policies like the hetero5
+    # artifact only hold their ring spacing this way). Default matches
+    # the reference's model.predict(deterministic=True)
+    # (visualize_policy.py:16).
+    deterministic = bool(cfg.get("deterministic", True))
 
     def playback_step(i, obs):
         if not quiet:
             print("-" * 10)
             print(f"Step {i}")
-        actions, _ = policy.predict(obs, deterministic=True)
+        actions, _ = policy.predict(obs, deterministic=deterministic)
         obs, rewards, dones, _ = env.step(actions)
         if not quiet:
             print(f"actions: {actions}")
