@@ -47,6 +47,23 @@ class PPOConfig:
     adam_eps: float = 1e-5  # SB3 ActorCriticPolicy optimizer default
     normalize_advantage: bool = True
     log_std_init: float = 0.0  # parity: the reference's -2 is a no-op (Q5)
+    # Entropy-coefficient decay (beyond SB3, which only schedules lr/clip):
+    # when ``ent_coef_final`` is set, the effective coefficient interpolates
+    # linearly from ``ent_coef`` to ``ent_coef_final`` over the run, keyed
+    # on the optimizer step already carried in ``TrainState.step`` — so it
+    # threads through vmapped populations, scan-fused dispatch, and
+    # checkpoint resume with zero extra state. Motivation: a constant
+    # entropy bonus can leave a policy RELYING on its action noise (the
+    # hetero5 artifact holds ring spacing only through noise — its mode
+    # action collapses, docs/acceptance/hetero5/). NB measured caveat:
+    # annealing removes the pressure to KEEP noise, but adds none to
+    # move its function into the mean — in the hetero5 budget the noise
+    # equilibrium was self-sustaining (entropy barely moved with the
+    # bonus at 5e-4), so evaluate as-trained (eval_deterministic=false)
+    # remains the honest measure for such policies. ``total_iterations``
+    # (the decay horizon, in iterations) is filled by the trainer shell.
+    ent_coef_final: Optional[float] = None
+    total_iterations: int = 0
 
     def make_optimizer(
         self, inject_lr: bool = False
@@ -99,8 +116,12 @@ def ppo_loss(
     apply_fn,
     mb: MinibatchData,
     config: PPOConfig,
+    ent_coef: Optional[Array] = None,
 ) -> Tuple[Array, Dict[str, Array]]:
-    """Clipped-surrogate PPO loss on one minibatch (SB3 semantics)."""
+    """Clipped-surrogate PPO loss on one minibatch (SB3 semantics).
+
+    ``ent_coef`` overrides ``config.ent_coef`` with a traced scalar when
+    the entropy coefficient is scheduled (``config.ent_coef_final``)."""
     if mb.mask is not None:
         mean, log_std, values = apply_fn(nn_params, mb.obs, mb.mask)
     else:
@@ -148,9 +169,12 @@ def ppo_loss(
     value_loss = _wmean((mb.returns - values) ** 2, w)
     entropy_loss = -ent  # state-independent Gaussian: scalar
 
+    effective_ent_coef = (
+        config.ent_coef if ent_coef is None else ent_coef
+    )
     loss = (
         policy_loss
-        + config.ent_coef * entropy_loss
+        + effective_ent_coef * entropy_loss
         + config.vf_coef * value_loss
     )
     metrics = {
@@ -185,11 +209,37 @@ def ppo_update(
     num_minibatches = total // batch_size
     used = num_minibatches * batch_size
 
+    decay = config.ent_coef_final is not None
+    if decay:
+        assert config.total_iterations > 0, (
+            "ent_coef_final requires total_iterations > 0 (the trainer "
+            "shell fills it; constructing PPOConfig by hand, pass the "
+            "planned iteration count)"
+        )
+        # Linear schedule on the optimizer step the TrainState already
+        # carries — resumes, vmapped populations, and fused dispatch all
+        # inherit the right position for free.
+        expected_total = (
+            config.total_iterations * config.n_epochs * num_minibatches
+        )
+
     grad_fn = jax.value_and_grad(ppo_loss, has_aux=True)
 
     def minibatch_step(ts: TrainState, idx: Array):
         mb = jax.tree_util.tree_map(lambda x: x[idx], data)
-        (_, metrics), grads = grad_fn(ts.params, ts.apply_fn, mb, config)
+        ent_coef = None
+        if decay:
+            progress = jnp.clip(
+                jnp.asarray(ts.step, jnp.float32) / expected_total, 0.0, 1.0
+            )
+            ent_coef = config.ent_coef + progress * (
+                config.ent_coef_final - config.ent_coef
+            )
+        (_, metrics), grads = grad_fn(
+            ts.params, ts.apply_fn, mb, config, ent_coef
+        )
+        if decay:
+            metrics["ent_coef"] = ent_coef
         ts = ts.apply_gradients(grads=grads)
         return ts, metrics
 

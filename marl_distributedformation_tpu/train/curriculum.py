@@ -39,7 +39,10 @@ from marl_distributedformation_tpu.env.hetero import (
     hetero_step_batch,
 )
 from marl_distributedformation_tpu.models import MLPActorCritic
-from marl_distributedformation_tpu.train.trainer import TrainConfig
+from marl_distributedformation_tpu.train.trainer import (
+    TrainConfig,
+    fill_ent_schedule,
+)
 from marl_distributedformation_tpu.utils import (
     MetricsLogger,
     Throughput,
@@ -159,6 +162,12 @@ class HeteroTrainer:
             num_obstacles=max(
                 curriculum.max_obstacles, env_params.num_obstacles
             ),
+        )
+        # The curriculum's budget is its stage plan: the entropy-decay
+        # horizon is the total rollout count across stages.
+        ppo = fill_ent_schedule(
+            ppo, self.env_params, config,
+            iterations=curriculum.total_rollouts,
         )
         self.ppo = ppo
         self.config = config

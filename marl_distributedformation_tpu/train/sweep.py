@@ -66,6 +66,7 @@ from marl_distributedformation_tpu.train.trainer import (
     TrainConfig,
     _burst,
     default_total_timesteps,
+    fill_ent_schedule,
     make_ppo_iteration,
 )
 from marl_distributedformation_tpu.utils import (
@@ -123,6 +124,9 @@ class SweepTrainer:
                 f"process_count={jax.process_count()} (even per-host "
                 "member construction)"
             )
+        # Every member runs the same per-member budget, so the single-run
+        # horizon formula applies unchanged (bit-compat with Trainer).
+        ppo = fill_ent_schedule(ppo, env_params, config)
         self.env_params = env_params
         self.ppo = ppo
         self.config = config

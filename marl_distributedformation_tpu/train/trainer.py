@@ -79,6 +79,28 @@ def default_total_timesteps(config: "TrainConfig") -> int:
     return 5000 * config.num_formations
 
 
+def fill_ent_schedule(
+    ppo: PPOConfig,
+    env_params: EnvParams,
+    config: "TrainConfig",
+    iterations: Optional[int] = None,
+) -> PPOConfig:
+    """Fill ``ppo.total_iterations`` (the entropy-decay horizon,
+    PPOConfig.ent_coef_final) from the run's planned iteration count.
+    No-op when no schedule is requested or the horizon is already set —
+    in particular, the default config path is left bit-identical."""
+    if ppo.ent_coef_final is None or ppo.total_iterations > 0:
+        return ppo
+    if iterations is None:
+        per_iter = (
+            config.num_formations * env_params.num_agents * ppo.n_steps
+        )
+        iterations = -(-default_total_timesteps(config) // per_iter)
+    return dataclasses.replace(
+        ppo, total_iterations=max(1, int(iterations))
+    )
+
+
 def make_ppo_iteration(
     env_params: EnvParams,
     ppo: PPOConfig,
@@ -205,6 +227,7 @@ class Trainer:
         model: Any = None,
         shard_fn: Any = None,
     ) -> None:
+        ppo = fill_ent_schedule(ppo, env_params, config)
         self.env_params = env_params
         self.ppo = ppo
         self.config = config

@@ -1,9 +1,12 @@
 """Tests for GAE, PPO loss, and the minibatch update."""
 
+import dataclasses
+
 import chex
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from marl_distributedformation_tpu.algo import (
     MinibatchData,
@@ -191,6 +194,52 @@ def test_ppo_update_improves_loss_and_changes_params():
     _, m0 = ppo_loss(ts.params, ts.apply_fn, data, config)
     _, m1 = ppo_loss(ts2.params, ts.apply_fn, data, config)
     assert float(m1["value_loss"]) < float(m0["value_loss"])
+
+
+def test_ent_coef_decay_matches_constant_when_degenerate():
+    """ent_coef_final == ent_coef must be BIT-IDENTICAL to no schedule:
+    the decay plumbing may not perturb unscheduled numerics."""
+    ts, config = _make_train_state()
+    data = _make_batch(ts, jax.random.PRNGKey(4), n=256)
+    plain, m_plain = ppo_update(ts, data, jax.random.PRNGKey(5), config)
+    degen = dataclasses.replace(
+        config, ent_coef_final=config.ent_coef, total_iterations=3
+    )
+    sched, m_sched = ppo_update(ts, data, jax.random.PRNGKey(5), degen)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain.params),
+        jax.tree_util.tree_leaves(sched.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert "ent_coef" not in m_plain
+    np.testing.assert_allclose(float(m_sched["ent_coef"]), config.ent_coef)
+
+
+def test_ent_coef_decay_anneals_with_optimizer_step():
+    """The coefficient interpolates ent_coef -> ent_coef_final on
+    TrainState.step: consecutive updates report strictly decreasing
+    means, reaching ~ent_coef_final by the horizon."""
+    ts, config = _make_train_state()
+    config = dataclasses.replace(
+        config, ent_coef_final=0.0, total_iterations=2
+    )
+    data = _make_batch(ts, jax.random.PRNGKey(4), n=256)
+    ts, m1 = ppo_update(ts, data, jax.random.PRNGKey(5), config)
+    ts, m2 = ppo_update(ts, data, jax.random.PRNGKey(6), config)
+    ts, m3 = ppo_update(ts, data, jax.random.PRNGKey(7), config)
+    c1, c2, c3 = (float(m["ent_coef"]) for m in (m1, m2, m3))
+    assert config.ent_coef >= c1 > c2 > c3 >= 0.0
+    # Past the horizon the schedule clamps at the final value.
+    ts, m4 = ppo_update(ts, data, jax.random.PRNGKey(8), config)
+    np.testing.assert_allclose(float(m4["ent_coef"]), 0.0, atol=1e-7)
+
+
+def test_ent_coef_decay_requires_horizon():
+    ts, config = _make_train_state()
+    config = dataclasses.replace(config, ent_coef_final=0.0)
+    data = _make_batch(ts, jax.random.PRNGKey(4), n=256)
+    with pytest.raises(AssertionError, match="total_iterations"):
+        ppo_update(ts, data, jax.random.PRNGKey(5), config)
 
 
 def test_ppo_update_batch_remainder_dropped():

@@ -39,6 +39,7 @@ def ppo_from_config(cfg) -> PPOConfig:
         max_grad_norm=cfg.max_grad_norm,
         normalize_advantage=cfg.normalize_advantage,
         log_std_init=cfg.log_std_init,
+        ent_coef_final=cfg.get("ent_coef_final"),
     )
 
 
