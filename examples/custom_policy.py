@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Plug a user-defined policy network into the trainer.
+
+The trainer shells (`Trainer`, `SweepTrainer`, `HeteroTrainer`) accept any
+flax module through ``model=`` as long as it satisfies the actor-critic
+contract the built-ins follow (models/mlp.py):
+
+- ``__call__(obs) -> (action_mean, log_std, value)`` where ``obs`` carries
+  any leading batch axes, ``action_mean`` has trailing dim ``act_dim``,
+  ``log_std`` is the Gaussian's state-independent log-scale, and ``value``
+  drops the trailing dim;
+- an optional class attribute ``per_formation`` (default False): False
+  means the model is applied per agent row (the reference's
+  parameter-sharing trick, vectorized_env.py:32); True means it sees whole
+  ``(M, N, obs_dim)`` formations (like the CTDE critic).
+
+This example defines a residual LayerNorm actor-critic — an architecture
+the built-in zoo does not ship — trains it briefly on CPU, and compares it
+against the scripted baseline controller on held-out formations.
+
+Run from the repo root (~2 minutes on one CPU core):
+
+    python examples/custom_policy.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+from typing import Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Array = jax.Array
+
+
+class ResidualActorCritic(nn.Module):
+    """Pre-LayerNorm residual MLP actor-critic (per-agent, shared params)."""
+
+    act_dim: int = 2
+    width: int = 64
+    blocks: int = 2
+    log_std_init: float = 0.0
+
+    @nn.compact
+    def __call__(self, obs: Array) -> Tuple[Array, Array, Array]:
+        def trunk(x: Array, tag: str) -> Array:
+            x = nn.Dense(self.width, name=f"{tag}_in")(x)
+            for i in range(self.blocks):
+                h = nn.LayerNorm(name=f"{tag}_ln{i}")(x)
+                h = nn.tanh(nn.Dense(self.width, name=f"{tag}_fc{i}")(h))
+                x = x + h  # residual: keeps gradients healthy when deep
+            return x
+
+        mean = nn.Dense(
+            self.act_dim,
+            kernel_init=nn.initializers.orthogonal(0.01),
+            name="pi_head",
+        )(trunk(obs, "pi"))
+        value = nn.Dense(
+            1, kernel_init=nn.initializers.orthogonal(1.0), name="vf_head"
+        )(trunk(obs, "vf"))
+        log_std = self.param(
+            "log_std",
+            nn.initializers.constant(self.log_std_init),
+            (self.act_dim,),
+        )
+        return mean, log_std, value[..., 0]
+
+
+def main() -> None:
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.eval import (
+        baseline_act_fn,
+        evaluate,
+        policy_act_fn,
+    )
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+    from marl_distributedformation_tpu.utils import setup_platform
+
+    setup_platform("cpu")  # the example targets a laptop; drop for TPU
+
+    env = EnvParams(num_agents=5)
+    model = ResidualActorCritic(act_dim=env.act_dim)
+    trainer = Trainer(
+        env,
+        # 1600 divides the rollout (64 formations x 5 agents x 10 steps =
+        # 3200 transitions) so every collected transition trains.
+        ppo=PPOConfig(batch_size=1600),
+        config=TrainConfig(
+            num_formations=64,
+            # EXAMPLE_TOTAL_TIMESTEPS / EXAMPLE_LOG_DIR let the test suite
+            # smoke this script end-to-end at a tiny budget in a tmp dir.
+            total_timesteps=int(
+                os.environ.get("EXAMPLE_TOTAL_TIMESTEPS", 320_000)
+            ),
+            name="example_custom_policy",
+            log_dir=os.environ.get(
+                "EXAMPLE_LOG_DIR", "logs/example_custom_policy"
+            ),
+            use_wandb=False,
+        ),
+        model=model,
+    )
+    last = trainer.train()
+    print(f"final training reward: {last['reward']:.2f}")
+
+    act = policy_act_fn(model, trainer.train_state.params, env)
+    ours = evaluate(act, env, num_formations=256)
+    base = evaluate(baseline_act_fn(env), env, num_formations=256)
+    print(
+        f"episode return/agent: custom policy "
+        f"{ours['episode_return_per_agent']:.1f} vs scripted baseline "
+        f"{base['episode_return_per_agent']:.1f}"
+    )
+
+
+if __name__ == "__main__":
+    main()
