@@ -105,6 +105,9 @@ def main() -> None:
                 "EXAMPLE_LOG_DIR", "logs/example_custom_policy"
             ),
             use_wandb=False,
+            # A demo's only output is the printed comparison — don't pay
+            # a checkpoint serialization every iteration.
+            checkpoint=False,
         ),
         model=model,
     )
