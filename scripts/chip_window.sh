@@ -40,7 +40,7 @@ mkdir -p "$STATE" docs/acceptance
 # killed run can't leave a truncated pseudo-artifact for `git add`. MUST
 # stay below the flock gate: before it, a bounced-off concurrent tick
 # would delete the lock-holder's in-flight tmp mid-rename.
-rm -f docs/acceptance/*.tmp
+rm -f docs/acceptance/*.tmp docs/acceptance/*/*.tmp
 
 probe() {
   # Test hook: CHIP_PROBE_CMD replaces the device probe so the
@@ -329,6 +329,45 @@ hetero5_stage() {
 }
 export -f hetero5_stage
 stage hetero5 1800 hetero5_stage
+
+# -- 8b. hetero5 eval-vs-baseline matrix (own stamp: a tunnel drop here
+# must not force re-training the curriculum). Quality evals are
+# platform-independent, and CPU-run evals of chip-trained checkpoints
+# are the repo's accepted convention (ctde20/gnn100 record "eval CPU")
+# — so unlike land_tpu_run this stage does NOT require tpu, but every
+# banked record must CARRY its resolved_platform (the promote gate
+# below rejects records whose provenance is absent). -------------------
+hetero5_eval_stage() {
+  [ -d logs/hetero5_tpu ] || return 1
+  local base="python evaluate.py name=hetero5_tpu eval_formations=512"
+  local n5="num_agents_per_formation=5"
+  local n20="num_agents_per_formation=20"
+  local obs="num_agents_per_formation=20 num_obstacles=4 obstacle_mode=fixed"
+  local cfg dest
+  for spec in "n5:$n5" "n20:$n20" "n20_obs:$obs"; do
+    cfg="${spec#*:}"
+    dest="${spec%%:*}"
+    eval "$base $cfg" | tail -1 \
+        > "docs/acceptance/hetero5/eval_${dest}_det.json.tmp" || return 1
+    eval "$base $cfg eval_deterministic=false" | tail -1 \
+        > "docs/acceptance/hetero5/eval_${dest}_stoch.json.tmp" || return 1
+  done
+  python - <<'EOF' || return 1
+import json, pathlib
+d = pathlib.Path("docs/acceptance/hetero5")
+for p in sorted(d.glob("eval_*.json.tmp")):
+    rec = json.loads(p.read_text())
+    assert "eval_deterministic" in rec and "beats_baseline" in rec, p
+    assert rec.get("resolved_platform"), f"no backend provenance: {p}"
+    p.rename(p.with_suffix(""))  # strip .tmp -> eval_*.json, atomic
+    print(
+        f"[hetero5_eval] {p.stem}: beats_baseline={rec['beats_baseline']}"
+        f" ({rec['resolved_platform']})"
+    )
+EOF
+}
+export -f hetero5_eval_stage
+stage hetero5_eval 1200 hetero5_eval_stage
 
 # -- 9. sweep workflow acceptance on the chip ---------------------------
 sweep8_stage() {

@@ -27,7 +27,7 @@ SCRIPT = REPO / "scripts" / "chip_window.sh"
 STAGES = [
     "parity", "knn_big", "bench_train", "bench_knn", "smoke",
     "profile", "tuning", "sweep_bench", "knn_big_tuning",
-    "hetero5", "sweep8", "bench",
+    "hetero5", "hetero5_eval", "sweep8", "bench",
 ]
 
 
