@@ -12,6 +12,17 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 @pytest.mark.slow
+def test_functional_env_example_runs():
+    res = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "functional_env.py")],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    # The example asserts convergence itself; pin its success line.
+    assert "converged" in res.stdout
+
+
+@pytest.mark.slow
 def test_custom_policy_example_runs(tmp_path):
     env = dict(os.environ)
     env["EXAMPLE_TOTAL_TIMESTEPS"] = "16000"
