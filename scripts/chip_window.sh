@@ -42,6 +42,27 @@ mkdir -p "$STATE" docs/acceptance
 # would delete the lock-holder's in-flight tmp mid-rename.
 rm -f docs/acceptance/*.tmp docs/acceptance/*/*.tmp
 
+# The smoke stamp aggregates per-path stamps: a grown tpu_smoke.py path
+# list must reopen it AND the ALL_DONE sentinel (a tunnel-down tick
+# exits before the bottom sentinel loop runs, so clearing only the
+# smoke stamp would leave ALL_DONE to short-circuit every future
+# watchdog tick). Pure local stamp reconciliation, so it runs before
+# the probe; `--list` is import-light (no jax). A failed --list must
+# not silently pass a stale stamp — warn and leave state untouched.
+if [ -f "$STATE/smoke" ]; then
+  if smoke_list=$(python scripts/tpu_smoke.py --list) \
+      && [ -n "$smoke_list" ]; then
+    for p in $smoke_list; do
+      if [ ! -f "$STATE/smoke_$p" ]; then
+        rm -f "$STATE/smoke" "$STATE/ALL_DONE"
+        break
+      fi
+    done
+  else
+    echo "WARNING: tpu_smoke.py --list failed; smoke stamp not reconciled"
+  fi
+fi
+
 probe() {
   # Test hook: CHIP_PROBE_CMD replaces the device probe so the
   # orchestration (stamps, resume, sentinel) is testable off-chip.

@@ -37,6 +37,7 @@ SMOKE_PATHS = (
     "mlp_tuned",
     "ctde",
     "gnn_knn100",
+    "gnn_swarm1024",
     "hetero_curriculum",
     "sweep_k4",
 )
@@ -106,6 +107,25 @@ def run_paths(m: int = 256, only: list[str] | None = None) -> dict:
                 k=4, act_dim=2, goal_in_obs=knn_params.goal_in_obs
             ),
             config=cfg("gnn", max(m // 8, 8)),
+        )
+    )
+
+    # N=1024 is past the fused kernel's VMEM cliff: on TPU the knn obs
+    # resolve to the chunked-streaming kernel (ops/knn_pallas.py
+    # knn_batch_pallas_big), so this path proves that kernel inside a
+    # FULL training iteration — rollout scan + GAE + update — not just
+    # the env-stepping loop bench.py times.
+    swarm_params = EnvParams(num_agents=1024, obs_mode="knn", knn_k=4)
+    paths["gnn_swarm1024"] = lambda: one_iteration(
+        Trainer(
+            swarm_params,
+            model=GNNActorCritic(
+                k=4, act_dim=2, goal_in_obs=swarm_params.goal_in_obs
+            ),
+            ppo=PPOConfig(**PRESETS["tpu"]),  # 640 batch-64 minibatches
+            #   per epoch would dominate the smoke; the preset batch keeps
+            #   the update a few MXU-shaped steps
+            config=cfg("gnn-swarm", max(m // 64, 2)),
         )
     )
 

@@ -82,6 +82,30 @@ def test_all_stamped_resumes_to_all_done(tmp_path):
     assert (state / "ALL_DONE").exists()
 
 
+def test_new_smoke_path_reopens_smoke_stamp(tmp_path):
+    """Adding a path to tpu_smoke.py must reopen a stamped smoke stage —
+    the aggregate stamp is only valid while every per-path stamp exists.
+    The reconciliation is pure local state, so it runs even on a
+    tunnel-down tick (probe stubbed false here)."""
+    state = tmp_path / "state"
+    state.mkdir()
+    (state / "smoke").touch()
+    (state / "ALL_DONE").touch()  # stale: must be reopened with it
+    paths = smoke_paths()
+    for p in paths[:-1]:  # the "new" path has no stamp yet
+        (state / f"smoke_{p}").touch()
+    res = run_burster(tmp_path, "false")
+    assert res.returncode == 0, res.stderr
+    assert not (state / "smoke").exists()
+    assert not (state / "ALL_DONE").exists()
+    # A fully-stamped path set must NOT reopen.
+    (state / f"smoke_{paths[-1]}").touch()
+    (state / "smoke").touch()
+    res = run_burster(tmp_path, "false")
+    assert res.returncode == 0, res.stderr
+    assert (state / "smoke").exists()
+
+
 def test_unstamped_stage_reopens_stale_all_done(tmp_path):
     """A grown stage list must clear a stale ALL_DONE sentinel —
     otherwise the watchdog short-circuits every tick and a newly added
