@@ -99,6 +99,20 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    def time_rate(trainer, dispatches: int, steps_per_dispatch: int):
+        """Shared timing harness for both sweeps: 2 warmup dispatches
+        (compile + the donated-shardings retrace), then one timed window
+        synced on the final loss."""
+        for _ in range(2):
+            metrics = trainer.run_iteration()
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            metrics = trainer.run_iteration()
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        return dispatches * steps_per_dispatch * m / dt
+
     rows = []
     for batch, lr in points:
         buffer_size = PPOConfig().n_steps * m * params.num_agents
@@ -113,15 +127,7 @@ def main() -> None:
                 name="tune",
             ),
         )
-        for _ in range(2):  # compile (+ the donated-shardings retrace)
-            metrics = trainer.run_iteration()
-        jax.block_until_ready(metrics["loss"])
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            metrics = trainer.run_iteration()
-        jax.block_until_ready(metrics["loss"])
-        dt = time.perf_counter() - t0
-        rate = iters * ppo.n_steps * m / dt
+        rate = time_rate(trainer, iters, ppo.n_steps)
 
         act = policy_act_fn(
             trainer.model, trainer.train_state.params, params
@@ -181,6 +187,45 @@ def main() -> None:
         )
     ok = [r for r in rows if r["quality_ok"]]
     best = max(ok, key=lambda r: r["train_steps_per_sec"]) if ok else None
+
+    # Fused-dispatch R sweep at the preset batch: find the
+    # RTT-amortization plateau for iters_per_dispatch. Throughput only —
+    # fused numerics are pinned bit-equal to single dispatch
+    # (tests/test_trainer.py::test_iters_per_dispatch_matches_single_
+    # dispatch), so no quality leg is needed. Ceil division gives every
+    # point a timing window of AT LEAST `iters` iterations; the R=1
+    # baseline is the main sweep's preset anchor row above (same config,
+    # already compiled and timed — not re-measured here).
+    fused_rows = []
+    for r_fuse in (4, 8, 16, 32):
+        if iters < r_fuse:
+            continue
+        trainer = Trainer(
+            params,
+            ppo=PPOConfig(batch_size=preset_batch),
+            config=TrainConfig(
+                num_formations=m, checkpoint=False, use_wandb=False,
+                name="tune-fused", iters_per_dispatch=r_fuse,
+            ),
+        )
+        dispatches = -(-iters // r_fuse)
+        rate = time_rate(trainer, dispatches, r_fuse * PPOConfig().n_steps)
+        fused_rows.append(
+            {
+                "iters_per_dispatch": r_fuse,
+                "train_steps_per_sec": round(rate, 1),
+            }
+        )
+        print(
+            f"[tune] fused R={r_fuse}: {rate:,.0f} formation-steps/s "
+            f"(batch={preset_batch})",
+            file=sys.stderr,
+        )
+    best_fused = (
+        max(fused_rows, key=lambda r: r["train_steps_per_sec"])
+        if fused_rows else None
+    )
+
     out = {
         "m": m,
         "iters_per_point": iters,
@@ -194,6 +239,8 @@ def main() -> None:
         },
         "points": rows,
         "best_quality_ok": best,
+        "fused_points": fused_rows,
+        "best_fused": best_fused,
     }
     print(json.dumps(out))
 
