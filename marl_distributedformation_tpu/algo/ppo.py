@@ -219,6 +219,13 @@ def ppo_update(
         # Linear schedule on the optimizer step the TrainState already
         # carries — resumes, vmapped populations, and fused dispatch all
         # inherit the right position for free.
+        # ASSUMES a constant rollout size across the run: the horizon is
+        # derived from THIS call's num_minibatches, while ts.step
+        # accumulated under every earlier call's count. All trainer
+        # shells keep rollout shape fixed (hetero pads to N_max), so the
+        # two agree; a variable-shape caller would miscalibrate the
+        # anneal and must fill total_iterations in minibatch-steps
+        # itself.
         expected_total = (
             config.total_iterations * config.n_epochs * num_minibatches
         )
@@ -229,8 +236,18 @@ def ppo_update(
         mb = jax.tree_util.tree_map(lambda x: x[idx], data)
         ent_coef = None
         if decay:
+            # Two-limb float split of the integer step: a straight
+            # float32(step) collapses consecutive steps past 2^24 (#
+            # reachable at parity batch_size=64 with large M), stalling
+            # the anneal near the horizon. hi < 2^24 for any int32 step
+            # and lo < 4096 are both exact in float32, so progress stays
+            # strictly monotone in step.
+            hi = jnp.asarray(ts.step // 4096, jnp.float32)
+            lo = jnp.asarray(ts.step % 4096, jnp.float32)
             progress = jnp.clip(
-                jnp.asarray(ts.step, jnp.float32) / expected_total, 0.0, 1.0
+                hi * (4096.0 / expected_total) + lo / expected_total,
+                0.0,
+                1.0,
             )
             ent_coef = config.ent_coef + progress * (
                 config.ent_coef_final - config.ent_coef

@@ -152,9 +152,36 @@ parity_stage() {
 export -f parity_stage
 stage parity 600 parity_stage
 
-# -- 2. knn_big alone — the one number that has NEVER been measured on
-# hardware (N=1024 chunked Pallas kernel past the VMEM cliff). A short
-# window must be able to secure it without finishing the full bench. ----
+# -- 2. full monolithic bench, FIRST after parity (round-5 reorder,
+# VERDICT r4 next-#2): the shipped tree needs a driver-grade chip record
+# under the retuned batch-16384 preset, and the round-4 ordering (bench
+# last) left the driver's BENCH_r04.json as a CPU fallback. Every phase
+# in one run, mirrored to tpu_bench_r5.md (supersedes the r4 record in
+# bench.py's replay-pointer glob). ------------------------------------
+bench_stage() {
+  local cmd="BENCH_BUDGET_S=540 python bench.py"
+  eval "$cmd" | tail -1 > /tmp/bench_tpu.json || return 1
+  cat /tmp/bench_tpu.json
+  # Hardware evidence only: scripts/check_bench_record.py refuses a
+  # fallback line, an errored run (e.g. bench.py's own watchdog fired
+  # mid-hang — it still emits a JSON line, with an "error" field and
+  # value 0), and a phase-incomplete run (bench.py degrades
+  # over-deadline phases into "... skipped"/"... failed" notes —
+  # mirroring such a line would enshrine a partial run as the round's
+  # record; retry next window).
+  python scripts/check_bench_record.py /tmp/bench_tpu.json \
+      --require value train_env_steps_per_sec train_env_steps_per_sec_tuned \
+                train_env_steps_per_sec_tuned_fused knn_env_steps_per_sec \
+                knn_big_env_steps_per_sec || return 1
+  python scripts/mirror_bench.py /tmp/bench_tpu.json \
+      docs/acceptance/tpu_bench_r5.md --command "$cmd"
+}
+export -f bench_stage
+stage bench 720 bench_stage
+
+# -- 3. knn_big alone — the N=1024 chunked Pallas kernel past the VMEM
+# cliff (first measured on hardware in round 4). A short window must be
+# able to secure it without finishing the full bench. ------------------
 knn_big_stage() {
   # SKIP_ENV_MAX: the shared gate rejects ANY failed/skipped phase note,
   # so don't run phases this stage doesn't require (env_max lands in the
@@ -168,7 +195,7 @@ knn_big_stage() {
       --require knn_big_env_steps_per_sec \
       --expect knn_big_impl=pallas_big || return 1
   python scripts/mirror_bench.py /tmp/bench_knn_big.json \
-      docs/acceptance/tpu_knn_big_r4.md --command "$cmd"
+      docs/acceptance/tpu_knn_big_r5.md --command "$cmd"
 }
 export -f knn_big_stage
 stage knn_big 420 knn_big_stage
@@ -190,7 +217,7 @@ bench_train_stage() {
   # bench.py's _latest_chip_bench_claim() treats those as FULL-bench
   # records when composing the CPU-fallback replay pointer.
   python scripts/mirror_bench.py /tmp/bench_train.json \
-      docs/acceptance/tpu_bench_train_r4.md --command "$cmd"
+      docs/acceptance/tpu_bench_train_r5.md --command "$cmd"
 }
 export -f bench_train_stage
 stage bench_train 600 bench_train_stage
@@ -203,7 +230,7 @@ bench_knn_stage() {
   python scripts/check_bench_record.py /tmp/bench_knn.json \
       --require knn_env_steps_per_sec --expect knn_impl=pallas || return 1
   python scripts/mirror_bench.py /tmp/bench_knn.json \
-      docs/acceptance/tpu_bench_knn_r4.md --command "$cmd"
+      docs/acceptance/tpu_bench_knn_r5.md --command "$cmd"
 }
 export -f bench_knn_stage
 stage bench_knn 420 bench_knn_stage
@@ -258,7 +285,9 @@ bank_txt_artifact() {
   { echo "# $title"
     echo "# date: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
     echo "# command: $cmd"
-    grep -v WARNING "$src"
+    # Anchored: only strip leading-WARNING log lines (jax/absl chatter),
+    # never a data row that merely contains the substring.
+    grep -v '^WARNING' "$src"
   } > "$dest.tmp" || { rm -f "$dest.tmp"; return 1; }
   mv "$dest.tmp" "$dest"
 }
@@ -272,7 +301,7 @@ tuning_stage() {
   # run); key on a NON-NULL sweep verdict — `"best_quality_ok": null`
   # means every point failed the eval quality guard and must not stamp.
   grep -q '"best_quality_ok": {' /tmp/tuning_out.txt || return 1
-  bank_txt_artifact /tmp/tuning_out.txt docs/acceptance/tpu_tuning_r4.txt \
+  bank_txt_artifact /tmp/tuning_out.txt docs/acceptance/tpu_tuning_r5.txt \
       "Big-batch tuning sweep — TPU v5 lite" "$cmd"
 }
 export -f tuning_stage
@@ -284,7 +313,7 @@ sweep_bench_stage() {
   eval "$cmd" | tee /tmp/sweep_bench_out.txt || return 1
   grep -q '"sweep_population_throughput"' /tmp/sweep_bench_out.txt || return 1
   bank_txt_artifact /tmp/sweep_bench_out.txt \
-      docs/acceptance/tpu_sweep_bench_r4.txt \
+      docs/acceptance/tpu_sweep_bench_r5.txt \
       "Population-sweep amortization bench — TPU v5 lite" "$cmd"
 }
 export -f sweep_bench_stage
@@ -294,11 +323,12 @@ stage sweep_bench 600 sweep_bench_stage
 knn_big_tuning_stage() {
   local cmd="python scripts/tpu_knn_big_tuning.py 512 1024 50"
   eval "$cmd" | tee /tmp/knn_big_tuning_out.txt || return 1
-  # `"best": {` is null when no candidate was bit-exact vs XLA — that is
+  # `"best": {` is null when no candidate matched XLA (indices exact +
+  # distances within atol; see tpu_knn_big_tuning.py) — that is
   # a kernel bug, not a tuning result; never stamp it.
   grep -q '"best": {' /tmp/knn_big_tuning_out.txt || return 1
   bank_txt_artifact /tmp/knn_big_tuning_out.txt \
-      docs/acceptance/tpu_knn_big_tuning_r4.txt \
+      docs/acceptance/tpu_knn_big_tuning_r5.txt \
       "Chunked k-NN kernel block-shape sweep — TPU v5 lite" "$cmd"
 }
 export -f knn_big_tuning_stage
@@ -338,8 +368,43 @@ EOF
 }
 export -f land_tpu_run
 
+# -- 7c. N=1024 GNN learning END-TO-END on hardware (VERDICT r4 next-#4):
+# the chunked-streaming Pallas kernel (ops/knn_pallas.py N>640 path) has
+# chip evidence inside the bench loop and a single smoke iteration; this
+# stage proves it inside the FULL training graph by banking a short
+# learning run (reward must improve over ~12 iterations) with its curve
+# and throughput. 12 iterations of M=8 x N=1024 x n_steps=10 = 983,040
+# agent-transitions under the tpu preset. ------------------------------
+gnn1024_learn_stage() {
+  # Fresh run dir: the metrics logger appends, so a retry after a
+  # timeout/tunnel-drop would otherwise mix rows from the dead attempt
+  # into the banked curve (and the learning gate would compare across
+  # runs).
+  rm -rf logs/gnn1024_tpu
+  python train.py name=gnn1024_tpu policy=gnn obs_mode=knn \
+    num_agents_per_formation=1024 num_formation=8 preset=tpu \
+    total_timesteps=983040 use_wandb=false || return 1
+  # Learning gate: a flat/degrading curve must not stamp — the point of
+  # the stage is evidence the kernel composes with the optimizer, not
+  # just that the graph executes.
+  python - <<'EOF' || return 1
+import json
+rows = [json.loads(l) for l in open("logs/gnn1024_tpu/metrics.jsonl") if l.strip()]
+assert len(rows) >= 10, f"only {len(rows)} iterations"
+first, last = rows[0]["reward"], rows[-1]["reward"]
+assert last > first, f"no learning: reward {first:.2f} -> {last:.2f}"
+print(f"[gnn1024] reward {first:.2f} -> {last:.2f} over {len(rows)} iters")
+EOF
+  mkdir -p docs/acceptance/gnn1024
+  land_tpu_run gnn1024_tpu docs/acceptance/gnn1024 \
+      "metrics_tpu.jsonl (N=1024 chunked-Pallas full-training learning curve)"
+}
+export -f gnn1024_learn_stage
+stage gnn1024_learn 1800 gnn1024_learn_stage
+
 # -- 8. config-5 hetero curriculum acceptance on the chip ---------------
 hetero5_stage() {
+  rm -rf logs/hetero5_tpu  # append-mode metrics: no cross-retry mixing
   python train.py name=hetero5_tpu num_formation=64 \
     num_agents_per_formation=20 preset=tpu total_timesteps=1280000 \
     use_wandb=false \
@@ -392,6 +457,7 @@ stage hetero5_eval 1200 hetero5_eval_stage
 
 # -- 9. sweep workflow acceptance on the chip ---------------------------
 sweep8_stage() {
+  rm -rf logs/sweep8_tpu  # append-mode metrics: no cross-retry mixing
   python train.py name=sweep8_tpu num_seeds=8 \
     num_formation=16 num_agents_per_formation=3 \
     strict_parity=false max_steps=64 \
@@ -420,33 +486,6 @@ EOF
 }
 export -f sweep8_stage
 stage sweep8 1800 sweep8_stage
-
-# -- 10. full bench, LAST (incl. the knn_big pallas phase). Every number
-# in it is already banked by the partial stages above, and the round
-# driver runs its own full bench.py at round end — so the monolithic
-# ~12-minute run must never starve the stages that produce UNIQUE
-# evidence (smoke paths, profile, tuning, acceptance trainings) by
-# retrying at the head of every short window. ------------------------
-bench_stage() {
-  local cmd="BENCH_BUDGET_S=540 python bench.py"
-  eval "$cmd" | tail -1 > /tmp/bench_tpu.json || return 1
-  cat /tmp/bench_tpu.json
-  # Hardware evidence only: scripts/check_bench_record.py refuses a
-  # fallback line, an errored run (e.g. bench.py's own watchdog fired
-  # mid-hang — it still emits a JSON line, with an "error" field and
-  # value 0), and a phase-incomplete run (bench.py degrades
-  # over-deadline phases into "... skipped"/"... failed" notes —
-  # mirroring such a line would enshrine a partial run as the round's
-  # record; retry next window).
-  python scripts/check_bench_record.py /tmp/bench_tpu.json \
-      --require value train_env_steps_per_sec train_env_steps_per_sec_tuned \
-                train_env_steps_per_sec_tuned_fused knn_env_steps_per_sec \
-                knn_big_env_steps_per_sec || return 1
-  python scripts/mirror_bench.py /tmp/bench_tpu.json \
-      docs/acceptance/tpu_bench_r4.md --command "$cmd"
-}
-export -f bench_stage
-stage bench 720 bench_stage
 
 echo "== window pass complete $(date -u +%Y-%m-%dT%H:%M:%SZ); state: =="
 ls "$STATE"

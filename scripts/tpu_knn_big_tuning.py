@@ -17,8 +17,9 @@ Run: python scripts/tpu_knn_big_tuning.py [M] [N] [iters]
      TUNE_BLOCKS="256:512:1,128:512:8" overrides the candidate list
      (block_r:chunk_c:block_m triples).
 Prints one table row per candidate + a summary JSON line (keyed
-``"metric": "knn_big_block_tuning"``; ``best`` = fastest bit-exact
-candidate).
+``"metric": "knn_big_block_tuning"``; ``best`` = fastest candidate whose
+neighbor indices match XLA exactly AND distances within atol=1e-4 — the
+two checks are recorded separately as ``indices_exact``/``dist_close``).
 """
 
 from __future__ import annotations
@@ -89,7 +90,7 @@ def main() -> None:
         else default_blocks()
     )
     rows = []
-    print(f"| block_r | chunk_c | block_m | us/call | bit-exact |")
+    print(f"| block_r | chunk_c | block_m | us/call | idx-exact+dist-close |")
     print(f"|---|---|---|---|---|")
     for block_r, chunk_c, block_m in blocks:
         rec = {
@@ -104,15 +105,25 @@ def main() -> None:
                 interpret=interpret,
             )
             idx, off, dist = jax.block_until_ready(run())  # compile+warm
-            exact = bool(jnp.array_equal(idx, ref_idx)) and bool(
-                jnp.allclose(dist, ref_dist, atol=1e-4)
-            )
+            # Two distinct checks, recorded as two distinct fields (the
+            # old single "bit_exact" flag overstated the distance leg):
+            # neighbor INDICES must match XLA exactly; distances only to
+            # atol=1e-4 (the chunked kernel accumulates in a different
+            # order, so the last float bit can differ legitimately).
+            indices_exact = bool(jnp.array_equal(idx, ref_idx))
+            dist_close = bool(jnp.allclose(dist, ref_dist, atol=1e-4))
+            exact = indices_exact and dist_close
             t0 = time.perf_counter()
             for _ in range(iters):
                 out = run()
             jax.block_until_ready(out)
             us = (time.perf_counter() - t0) / iters * 1e6
-            rec.update(us_per_call=round(us, 1), bit_exact=exact, ok=True)
+            rec.update(
+                us_per_call=round(us, 1),
+                indices_exact=indices_exact,
+                dist_close=dist_close,
+                ok=True,
+            )
             print(
                 f"| {block_r} | {chunk_c} | {block_m} | {us:,.1f} |"
                 f" {exact} |"
@@ -125,7 +136,10 @@ def main() -> None:
             )
         rows.append(rec)
 
-    good = [r for r in rows if r.get("ok") and r.get("bit_exact")]
+    good = [
+        r for r in rows
+        if r.get("ok") and r.get("indices_exact") and r.get("dist_close")
+    ]
     best = min(good, key=lambda r: r["us_per_call"]) if good else None
     anchor = next(
         (

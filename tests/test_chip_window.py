@@ -21,13 +21,14 @@ SCRIPT = REPO / "scripts" / "chip_window.sh"
 
 # Stage names as chip_window.sh defines them, plus the per-path smoke
 # stamps derived from tpu_smoke.py --list.
-# The monolithic full bench runs LAST: all its numbers are banked by the
-# partial stages, and it must not starve the unique-evidence stages by
-# retrying at the head of every short window.
+# Round-5 order (VERDICT r4 next-#2): the monolithic full bench runs
+# FIRST after parity so the shipped tree gets a driver-grade chip record
+# under the retuned batch-16384 preset at the earliest window, instead of
+# the round-4 tail position that left BENCH_r04.json a CPU fallback.
 STAGES = [
-    "parity", "knn_big", "bench_train", "bench_knn", "smoke",
+    "parity", "bench", "knn_big", "bench_train", "bench_knn", "smoke",
     "profile", "tuning", "sweep_bench", "knn_big_tuning",
-    "hetero5", "hetero5_eval", "sweep8", "bench",
+    "gnn1024_learn", "hetero5", "hetero5_eval", "sweep8",
 ]
 
 
@@ -110,8 +111,16 @@ def test_unstamped_stage_reopens_stale_all_done(tmp_path):
     """A grown stage list must clear a stale ALL_DONE sentinel —
     otherwise the watchdog short-circuits every tick and a newly added
     stage silently never runs. The unstamped stage is made to fail
-    instantly by stripping python from PATH (probe stays stubbed up),
-    so this pins the sentinel logic, not the stage itself."""
+    instantly by shadowing `python` with an exit-1 stub at the head of
+    PATH (probe stays stubbed up) — shadowing, not stripping, so the
+    failure mode doesn't depend on whether the distro ships
+    /usr/bin/python (python-is-python3). This pins the sentinel logic,
+    not the stage itself."""
+    stub_bin = tmp_path / "bin"
+    stub_bin.mkdir()
+    stub = stub_bin / "python"
+    stub.write_text("#!/bin/sh\nexit 1\n")
+    stub.chmod(0o755)
     state = tmp_path / "state"
     state.mkdir()
     for s in STAGES:
@@ -120,7 +129,7 @@ def test_unstamped_stage_reopens_stale_all_done(tmp_path):
         (state / f"smoke_{p}").touch()
     (state / "ALL_DONE").touch()
     (state / "profile").unlink()  # the queue grew / a stamp was cleared
-    res = run_burster(tmp_path, "true", path="/usr/bin:/bin")
+    res = run_burster(tmp_path, "true", path=f"{stub_bin}:/usr/bin:/bin")
     assert res.returncode == 0, res.stderr
     assert "== stage profile " in res.stdout
     assert "ALL stages stamped" not in res.stdout
@@ -196,4 +205,4 @@ def test_partial_mirror_names_dodge_replay_glob():
     assert mirrors, "burster no longer writes mirrors?"
     full = [m for m in mirrors if fnmatch.fnmatch(m, "tpu_bench_r*.md")]
     # Exactly the monolithic full-bench record may match the glob.
-    assert full == ["tpu_bench_r4.md"], full
+    assert full == ["tpu_bench_r5.md"], full
