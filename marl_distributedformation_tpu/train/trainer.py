@@ -85,11 +85,14 @@ def fill_ent_schedule(
     config: "TrainConfig",
     iterations: Optional[int] = None,
 ) -> PPOConfig:
-    """Fill ``ppo.total_iterations`` (the entropy-decay horizon,
-    PPOConfig.ent_coef_final) from the run's planned iteration count.
+    """Fill ``ppo.total_iterations`` (the shared decay horizon for the
+    ``ent_coef_final`` entropy schedule and the ``log_std_final``
+    noise-decay schedule) from the run's planned iteration count.
     No-op when no schedule is requested or the horizon is already set —
     in particular, the default config path is left bit-identical."""
-    if ppo.ent_coef_final is None or ppo.total_iterations > 0:
+    if (
+        ppo.ent_coef_final is None and ppo.log_std_final is None
+    ) or ppo.total_iterations > 0:
         return ppo
     if iterations is None:
         per_iter = (

@@ -405,10 +405,18 @@ stage gnn1024_learn 1800 gnn1024_learn_stage
 # -- 8. config-5 hetero curriculum acceptance on the chip ---------------
 hetero5_stage() {
   rm -rf logs/hetero5_tpu  # append-mode metrics: no cross-retry mixing
+  # Round-5 recipe (VERDICT r4 next-#1, measured on CPU — see
+  # docs/acceptance/hetero5/README.md): a 100-rollout fine-tune stage on
+  # the final environment (spans a FULL 1000-step episode, so long-horizon
+  # station-keeping is on-distribution) with the action noise annealed
+  # out over the back half (log_std_final=-2.5, decay_start=0.5) and the
+  # entropy bonus annealed to 0. Result: the DETERMINISTIC mode action
+  # beats the scripted baseline in all three eval rows.
   python train.py name=hetero5_tpu num_formation=64 \
-    num_agents_per_formation=20 preset=tpu total_timesteps=1280000 \
+    num_agents_per_formation=20 preset=tpu total_timesteps=2560000 \
+    ent_coef_final=0.0 log_std_final=-2.5 log_std_decay_start=0.5 \
     use_wandb=false \
-    "curriculum=[{rollouts: 30, agent_counts: [5]}, {rollouts: 40, agent_counts: [5, 20]}, {rollouts: 30, agent_counts: [5, 20], num_obstacles: 4}]" \
+    "curriculum=[{rollouts: 30, agent_counts: [5]}, {rollouts: 40, agent_counts: [5, 20]}, {rollouts: 30, agent_counts: [5, 20], num_obstacles: 4}, {rollouts: 100, agent_counts: [5, 20], num_obstacles: 4}]" \
     || return 1
   land_tpu_run hetero5_tpu docs/acceptance/hetero5 \
       "metrics_tpu.jsonl (full learning curve)"
@@ -441,10 +449,23 @@ hetero5_eval_stage() {
   python - <<'EOF' || return 1
 import json, pathlib
 d = pathlib.Path("docs/acceptance/hetero5")
-for p in sorted(d.glob("eval_*.json.tmp")):
+tmps = sorted(d.glob("eval_*.json.tmp"))
+# Two passes: validate EVERYTHING, then rename — a gate failure on a
+# later row must not have already banked earlier rows over the
+# committed evidence (the whole point of the gate is that a failed
+# retrain leaves the prior records standing).
+for p in tmps:
     rec = json.loads(p.read_text())
     assert "eval_deterministic" in rec and "beats_baseline" in rec, p
     assert rec.get("resolved_platform"), f"no backend provenance: {p}"
+    # Round-5 gate (VERDICT r4 next-#1 done-criterion): the
+    # DETERMINISTIC mode action must beat the baseline in every det
+    # row (stoch rows are recorded but not gated — the criterion is
+    # about the mode action).
+    if rec["eval_deterministic"]:
+        assert rec["beats_baseline"], f"mode loses to baseline: {p}"
+for p in tmps:
+    rec = json.loads(p.read_text())
     p.rename(p.with_suffix(""))  # strip .tmp -> eval_*.json, atomic
     print(
         f"[hetero5_eval] {p.stem}: beats_baseline={rec['beats_baseline']}"
@@ -458,10 +479,15 @@ stage hetero5_eval 1200 hetero5_eval_stage
 # -- 9. sweep workflow acceptance on the chip ---------------------------
 sweep8_stage() {
   rm -rf logs/sweep8_tpu  # append-mode metrics: no cross-retry mixing
+  # ent_coef_final=0.0 (round 5): the round-4 population's late-training
+  # decline traces to the constant entropy bonus inflating log_std all
+  # run (entropy 2.85 -> 3.16, per-dim std > 1 = near-uniform actions);
+  # annealing the bonus holds entropy flat. Root-cause analysis with CPU
+  # repro curves: docs/acceptance/sweep8/REGRESSION.md.
   python train.py name=sweep8_tpu num_seeds=8 \
     num_formation=16 num_agents_per_formation=3 \
     strict_parity=false max_steps=64 \
-    n_steps=16 batch_size=192 n_epochs=4 \
+    n_steps=16 batch_size=192 n_epochs=4 ent_coef_final=0.0 \
     total_timesteps=153600 save_freq=3200 use_wandb=false || return 1
   python evaluate.py name=sweep8_tpu num_formation=16 \
     num_agents_per_formation=3 strict_parity=false max_steps=64 \

@@ -200,7 +200,7 @@ def test_ent_coef_decay_matches_constant_when_degenerate():
     """ent_coef_final == ent_coef must be BIT-IDENTICAL to no schedule:
     the decay plumbing may not perturb unscheduled numerics."""
     ts, config = _make_train_state()
-    data = _make_batch(ts, jax.random.PRNGKey(4), n=256)
+    data = _make_batch(ts, jax.random.PRNGKey(4), n=64)
     plain, m_plain = ppo_update(ts, data, jax.random.PRNGKey(5), config)
     degen = dataclasses.replace(
         config, ent_coef_final=config.ent_coef, total_iterations=3
@@ -223,7 +223,7 @@ def test_ent_coef_decay_anneals_with_optimizer_step():
     config = dataclasses.replace(
         config, ent_coef_final=0.0, total_iterations=2
     )
-    data = _make_batch(ts, jax.random.PRNGKey(4), n=256)
+    data = _make_batch(ts, jax.random.PRNGKey(4), n=64)
     ts, m1 = ppo_update(ts, data, jax.random.PRNGKey(5), config)
     ts, m2 = ppo_update(ts, data, jax.random.PRNGKey(6), config)
     ts, m3 = ppo_update(ts, data, jax.random.PRNGKey(7), config)
@@ -237,6 +237,66 @@ def test_ent_coef_decay_anneals_with_optimizer_step():
 def test_ent_coef_decay_requires_horizon():
     ts, config = _make_train_state()
     config = dataclasses.replace(config, ent_coef_final=0.0)
+    data = _make_batch(ts, jax.random.PRNGKey(4), n=256)
+    with pytest.raises(AssertionError, match="total_iterations"):
+        ppo_update(ts, data, jax.random.PRNGKey(5), config)
+
+
+def test_log_std_decay_projects_parameter_to_ceiling():
+    """log_std_final clamps the LEARNED log_std parameter under a
+    linearly-decaying ceiling: by the horizon the parameter itself sits
+    at/below the final value — so the checkpointed policy IS the
+    narrow-noise policy and deterministic eval stops misrepresenting
+    it. (A loss-term pull could not do this: clipped-Adam steps are
+    ~learning_rate-sized, far too slow to traverse nats in-run.)"""
+    ts, config = _make_train_state()
+    config = dataclasses.replace(
+        config, log_std_final=-2.0, total_iterations=4
+    )
+    data = _make_batch(ts, jax.random.PRNGKey(4), n=64)
+    start = float(np.asarray(ts.params["params"]["log_std"]).max())
+    for k in range(8):
+        ts, m = ppo_update(ts, data, jax.random.PRNGKey(10 + k), config)
+    end = float(np.asarray(ts.params["params"]["log_std"]).max())
+    assert start == 0.0  # parity init
+    assert end <= -2.0 + 1e-6, f"log_std above final ceiling: {end}"
+    # Past the horizon the ceiling clamps at the final value.
+    np.testing.assert_allclose(
+        float(m["log_std_ceiling"]), -2.0, atol=1e-6
+    )
+    # The entropy schedule was NOT engaged (independent knobs).
+    assert "ent_coef" not in m
+
+
+def test_log_std_decay_touches_only_log_std():
+    """The projection is path-keyed: a single-minibatch update with the
+    schedule must leave every non-log_std parameter BIT-IDENTICAL to the
+    plain run (the schedule adds no loss term and no gradient), and clamp
+    log_std to the ceiling."""
+    ts, config = _make_train_state()
+    config = dataclasses.replace(config, n_epochs=1, batch_size=256)
+    data = _make_batch(ts, jax.random.PRNGKey(4), n=256)
+    plain, _ = ppo_update(ts, data, jax.random.PRNGKey(5), config)
+    sched_cfg = dataclasses.replace(
+        config, log_std_final=-2.0, total_iterations=3
+    )
+    sched, m_sched = ppo_update(ts, data, jax.random.PRNGKey(5), sched_cfg)
+    flat_plain = jax.tree_util.tree_flatten_with_path(plain.params)[0]
+    flat_sched = jax.tree_util.tree_flatten_with_path(sched.params)[0]
+    for (path, a), (_, b) in zip(flat_plain, flat_sched):
+        name = getattr(path[-1], "key", None)
+        if name == "log_std":
+            np.testing.assert_array_equal(
+                np.asarray(b),
+                np.minimum(np.asarray(a), float(m_sched["log_std_ceiling"])),
+            )
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_log_std_decay_requires_horizon():
+    ts, config = _make_train_state()
+    config = dataclasses.replace(config, log_std_final=-2.0)
     data = _make_batch(ts, jax.random.PRNGKey(4), n=256)
     with pytest.raises(AssertionError, match="total_iterations"):
         ppo_update(ts, data, jax.random.PRNGKey(5), config)

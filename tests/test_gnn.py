@@ -134,6 +134,7 @@ def test_gnn_shapes_and_locality():
     assert abs(float(value2[0, 0] - value[0, 0])) > 1e-7
 
 
+@pytest.mark.slow
 def test_gnn_mask_blocks_padded_neighbors():
     k, n = 2, 6
     obs_dim = EnvParams(num_agents=n, obs_mode="knn", knn_k=k).obs_dim

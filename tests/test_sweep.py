@@ -51,6 +51,7 @@ def _leaves_allclose(a, b, rtol=1e-5, atol=1e-6):
         )
 
 
+@pytest.mark.slow
 def test_member_matches_single_trainer(tmp_path):
     """Member i of a K=2 sweep == Trainer(seed=i), params and metrics."""
     params = EnvParams(num_agents=3)
@@ -159,6 +160,7 @@ def test_knn_sweep_on_mesh(tmp_path):
     assert np.isfinite(np.asarray(metrics["reward"])).all()
 
 
+@pytest.mark.slow
 def test_lr_sweep_members_train_at_their_own_rate(tmp_path):
     """Per-member learning rates: lr=0 freezes that member, a nonzero-lr
     member matches a single Trainer run at that rate (the inject_hyperparams
@@ -394,6 +396,7 @@ def test_sweep_composes_with_ctde_and_gnn(tmp_path):
     assert np.isfinite(np.asarray(m["loss"])).all()
 
 
+@pytest.mark.slow
 def test_sweep_iters_per_dispatch_matches_single(tmp_path):
     """The scan-fused dispatch (iters_per_dispatch=2) advances the
     population like two single dispatches; curriculum rejects the knob."""
@@ -435,9 +438,8 @@ def _leaves_equal(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
-@pytest.mark.parametrize(
-    "lr_sweep", [False, pytest.param(True, marks=pytest.mark.slow)]
-)
+@pytest.mark.slow
+@pytest.mark.parametrize("lr_sweep", [False, True])
 def test_sweep_resume_bit_exact(tmp_path, lr_sweep):
     """An interrupted sweep resumed from its sweep_state checkpoint ends
     bit-identical to an uninterrupted run — params, optimizer state
@@ -491,6 +493,7 @@ def test_sweep_resume_bit_exact(tmp_path, lr_sweep):
     )
 
 
+@pytest.mark.slow
 def test_sweep_resume_rejects_mismatches(tmp_path):
     """Identity mismatches (population size, lr-sweep mode) must fail
     loudly, not silently re-seed members."""

@@ -192,6 +192,7 @@ def test_dotted_override_under_null_key():
     assert str(cfg2.name) == "2024"
 
 
+@pytest.mark.slow
 def test_resume_reapplies_sharding(tmp_path):
     from marl_distributedformation_tpu.parallel import make_shard_fn
 
@@ -212,6 +213,7 @@ def test_resume_reapplies_sharding(tmp_path):
     assert not resumed.env_state.agents.sharding.is_fully_replicated
 
 
+@pytest.mark.slow
 def test_profile_flag_writes_trace(tmp_path):
     """profile=True captures a jax.profiler trace of post-warmup iterations
     into {log_dir}/profile/ (VERDICT.md round-1 #6)."""

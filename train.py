@@ -40,6 +40,8 @@ def ppo_from_config(cfg) -> PPOConfig:
         normalize_advantage=cfg.normalize_advantage,
         log_std_init=cfg.log_std_init,
         ent_coef_final=cfg.get("ent_coef_final"),
+        log_std_final=cfg.get("log_std_final"),
+        log_std_decay_start=float(cfg.get("log_std_decay_start") or 0.0),
     )
 
 
