@@ -76,3 +76,30 @@ def test_shim_trains_and_snapshots_config(tmp_path, monkeypatch):
     (run_dir / "config.json").unlink()
     shim.main(args + ["resume=true", "total_timesteps=360"])
     assert not (run_dir / "config.json").exists()
+
+
+def test_ppo_from_config_null_schedule_knobs():
+    """Explicit null overrides of the optional schedule knobs must parse
+    as 'off', not crash (log_std_decay_start=null used to hit
+    float(None))."""
+    cfg = load_config(
+        [
+            "name=x",
+            "ent_coef_final=null",
+            "log_std_final=null",
+            "log_std_decay_start=null",
+        ]
+    )
+    ppo = train_cli.ppo_from_config(cfg)
+    assert ppo.ent_coef_final is None
+    assert ppo.log_std_final is None
+    assert ppo.log_std_decay_start == 0.0
+
+
+def test_ppo_from_config_schedule_knobs_forwarded():
+    cfg = load_config(
+        ["name=x", "log_std_final=-2.5", "log_std_decay_start=0.5"]
+    )
+    ppo = train_cli.ppo_from_config(cfg)
+    assert ppo.log_std_final == -2.5
+    assert ppo.log_std_decay_start == 0.5
