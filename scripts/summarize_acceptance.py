@@ -61,16 +61,34 @@ def curriculum_table(rows: list[dict]) -> str:
 
 
 def sweep_table(rows: list[dict]) -> str:
-    picks = sorted({1, len(rows) // 2, len(rows)})
+    """Population trajectory as WINDOWED means (trailing 25 iterations),
+    not single-iteration samples: at small sweep scales the per-iteration
+    noise is large (±6 at the sweep8 config), and point samples misread a
+    plateau as a regression — the round-4 lesson recorded in
+    docs/acceptance/sweep8/REGRESSION.md."""
+    win = 25
+    # Every column is windowed (trailing <=25 rows ending at the pick) so
+    # mean/best/worst are mutually consistent; picks of 0 (len//2 of a
+    # 1-row file) are dropped rather than averaging an empty window.
+    picks = sorted({1, len(rows) // 2, len(rows)} - {0})
     out = [
+        f"<!-- mean/best/worst each over the trailing <={win}-iter "
+        "window ending at the pick -->",
         "| iteration | population mean reward | best | worst | best_seed |",
         "|---|---|---|---|---|",
     ]
     for i in picks:
-        r = rows[i - 1]
+        w = rows[max(0, i - win) : i]
+        wmean = sum(r["reward"] for r in w) / len(w)
+        bests = [r["reward_best"] for r in w if r.get("reward_best") is not None]
+        worsts = [
+            r["reward_worst"] for r in w if r.get("reward_worst") is not None
+        ]
         out.append(
-            f"| {i} | {fmt(r['reward'])} | {fmt(r.get('reward_best'))} | "
-            f"{fmt(r.get('reward_worst'))} | {int(r.get('best_seed', -1))} |"
+            f"| {i} | {fmt(wmean)} | "
+            f"{fmt(max(bests) if bests else None)} | "
+            f"{fmt(min(worsts) if worsts else None)} | "
+            f"{int(rows[i - 1].get('best_seed', -1))} |"
         )
     return "\n".join(out)
 
