@@ -13,5 +13,9 @@ from marl_distributedformation_tpu.train.curriculum import (  # noqa: F401
     CurriculumStage,
     HeteroTrainer,
     curriculum_from_cfg,
+    make_hetero_iteration,
     sample_stage_counts,
+)
+from marl_distributedformation_tpu.train.hetero_sweep import (  # noqa: F401
+    HeteroSweepTrainer,
 )

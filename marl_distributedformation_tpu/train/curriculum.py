@@ -233,85 +233,11 @@ class HeteroTrainer:
     # ------------------------------------------------------------------
 
     def _make_iteration(self):
-        env_params, ppo = self.env_params, self.ppo
-        n_max = env_params.num_agents
-        per_formation = self.per_formation
-        if per_formation:
-            # Minibatch whole formations so the centralized critic sees every
-            # agent; batch_size stays denominated in agent-transitions for
-            # comparable SGD noise across policies (same as train.Trainer).
-            update_ppo = dataclasses.replace(
-                ppo, batch_size=max(1, ppo.batch_size // n_max)
-            )
-            row_shape = (n_max,)
-        else:
-            update_ppo = ppo
-            row_shape = ()
+        return make_hetero_iteration(
+            self.env_params, self.ppo, self.per_formation
+        )
 
-        def env_step(state: HeteroState, velocity: Array):
-            return hetero_step_batch(state, velocity, env_params)
 
-        def iteration(
-            train_state: TrainState,
-            env_state: HeteroState,
-            obs: Array,
-            key: Array,
-        ):
-            key, k_roll, k_update = jax.random.split(key, 3)
-            # n_agents is preserved across auto-resets, so one (M, N_max)
-            # mask covers every step of the rollout (and the whole stage).
-            mask = jax.vmap(agent_mask, in_axes=(0, None))(
-                env_state.n_agents, n_max
-            ).astype(jnp.float32)
-            env_state, last_obs, batch, last_value = collect_rollout(
-                train_state.apply_fn,
-                train_state.params,
-                env_state,
-                obs,
-                k_roll,
-                env_params,
-                ppo.n_steps,
-                env_step_fn=env_step,
-                mask=mask if per_formation else None,
-            )
-            advantages, returns = compute_gae(
-                batch.rewards,
-                batch.values,
-                batch.dones,
-                last_value,
-                ppo.gamma,
-                ppo.gae_lambda,
-            )
-            weights = jnp.broadcast_to(
-                mask[None], (ppo.n_steps, *mask.shape)
-            ).reshape(-1, *row_shape)
-            flat = MinibatchData(
-                obs=batch.obs.reshape(-1, *row_shape, env_params.obs_dim),
-                actions=batch.actions.reshape(
-                    -1, *row_shape, env_params.act_dim
-                ),
-                old_log_probs=batch.log_probs.reshape(-1, *row_shape),
-                advantages=advantages.reshape(-1, *row_shape),
-                returns=returns.reshape(-1, *row_shape),
-                weights=weights,
-                mask=weights if per_formation else None,
-            )
-            train_state, update_metrics = ppo_update(
-                train_state, flat, k_update, update_ppo
-            )
-            metrics = {k: v.mean() for k, v in batch.metrics.items()}
-            metrics.update(update_metrics)
-            w_flat = weights.reshape(-1)
-            w = jnp.maximum(w_flat.sum(), 1.0)
-            metrics["reward"] = (batch.rewards.reshape(-1) * w_flat).sum() / w
-            # Formation-level episode count: batch.dones is the per-formation
-            # done broadcast to all N_max agent rows (rollout.py), so a plain
-            # sum counts every padded row, inflating the count x N_max.
-            # Agent row 0 is always active (n >= 2).
-            metrics["episode_dones"] = batch.dones[..., 0].sum()
-            return train_state, env_state, last_obs, key, metrics
-
-        return iteration
 
     # ------------------------------------------------------------------
     # Imperative shell
@@ -512,6 +438,94 @@ class HeteroTrainer:
             f"[hetero] resumed at {self.num_timesteps} steps "
             f"({self.completed_rollouts} rollouts)"
         )
+
+
+
+
+def make_hetero_iteration(env_params, ppo, per_formation: bool):
+    """Build the functional hetero training iteration (rollout + GAE +
+    update over padded dynamic-count formations) as one pure function —
+    the heterogeneous analog of ``trainer.make_ppo_iteration``.
+    Module-level so other shells can transform it: ``HeteroTrainer`` jits
+    it directly; ``HeteroSweepTrainer`` (train/hetero_sweep.py) vmaps it
+    over a candidate-seed population before jitting."""
+    n_max = env_params.num_agents
+    if per_formation:
+        # Minibatch whole formations so the centralized critic sees every
+        # agent; batch_size stays denominated in agent-transitions for
+        # comparable SGD noise across policies (same as train.Trainer).
+        update_ppo = dataclasses.replace(
+            ppo, batch_size=max(1, ppo.batch_size // n_max)
+        )
+        row_shape = (n_max,)
+    else:
+        update_ppo = ppo
+        row_shape = ()
+
+    def env_step(state: HeteroState, velocity: Array):
+        return hetero_step_batch(state, velocity, env_params)
+
+    def iteration(
+        train_state: TrainState,
+        env_state: HeteroState,
+        obs: Array,
+        key: Array,
+    ):
+        key, k_roll, k_update = jax.random.split(key, 3)
+        # n_agents is preserved across auto-resets, so one (M, N_max)
+        # mask covers every step of the rollout (and the whole stage).
+        mask = jax.vmap(agent_mask, in_axes=(0, None))(
+            env_state.n_agents, n_max
+        ).astype(jnp.float32)
+        env_state, last_obs, batch, last_value = collect_rollout(
+            train_state.apply_fn,
+            train_state.params,
+            env_state,
+            obs,
+            k_roll,
+            env_params,
+            ppo.n_steps,
+            env_step_fn=env_step,
+            mask=mask if per_formation else None,
+        )
+        advantages, returns = compute_gae(
+            batch.rewards,
+            batch.values,
+            batch.dones,
+            last_value,
+            ppo.gamma,
+            ppo.gae_lambda,
+        )
+        weights = jnp.broadcast_to(
+            mask[None], (ppo.n_steps, *mask.shape)
+        ).reshape(-1, *row_shape)
+        flat = MinibatchData(
+            obs=batch.obs.reshape(-1, *row_shape, env_params.obs_dim),
+            actions=batch.actions.reshape(
+                -1, *row_shape, env_params.act_dim
+            ),
+            old_log_probs=batch.log_probs.reshape(-1, *row_shape),
+            advantages=advantages.reshape(-1, *row_shape),
+            returns=returns.reshape(-1, *row_shape),
+            weights=weights,
+            mask=weights if per_formation else None,
+        )
+        train_state, update_metrics = ppo_update(
+            train_state, flat, k_update, update_ppo
+        )
+        metrics = {k: v.mean() for k, v in batch.metrics.items()}
+        metrics.update(update_metrics)
+        w_flat = weights.reshape(-1)
+        w = jnp.maximum(w_flat.sum(), 1.0)
+        metrics["reward"] = (batch.rewards.reshape(-1) * w_flat).sum() / w
+        # Formation-level episode count: batch.dones is the per-formation
+        # done broadcast to all N_max agent rows (rollout.py), so a plain
+        # sum counts every padded row, inflating the count x N_max.
+        # Agent row 0 is always active (n >= 2).
+        metrics["episode_dones"] = batch.dones[..., 0].sum()
+        return train_state, env_state, last_obs, key, metrics
+
+    return iteration
 
 
 def curriculum_from_cfg(cfg: Any) -> Curriculum:

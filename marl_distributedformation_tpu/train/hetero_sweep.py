@@ -1,0 +1,426 @@
+"""Candidate-seed populations of the heterogeneous curriculum in ONE jit.
+
+Why this exists (round 5): deterministic-mode quality of the config-5
+curriculum is SEED-VARIANT — the CPU study behind
+docs/acceptance/hetero5/README.md measured only ~1/3-1/2 of seeds
+producing a mode action that beats the scripted baseline in every eval
+row, and a same-seed retrain is deterministic, so the chip acceptance
+workflow was train-one-candidate -> det-gate -> reseed, one tunnel
+window per candidate. This trainer collapses that loop: K candidate
+seeds of the FULL curriculum train simultaneously as one vmapped XLA
+program (the population axis is embarrassingly parallel — zero
+collectives), so ONE window trains every candidate and held-out
+deterministic evaluation (evaluate.py's sweep mode ranks all members)
+selects the winner.
+
+Composition of two existing shells, not new machinery:
+
+- the functional iteration is ``curriculum.make_hetero_iteration`` —
+  the exact program ``HeteroTrainer`` jits — ``jax.vmap``-ed over a
+  leading (K,) member axis (the ``SweepTrainer`` pattern,
+  train/sweep.py);
+- member ``i`` follows ``HeteroTrainer(seed=config.seed + i)``'s key
+  discipline exactly — init split, per-stage count/env splits — so a
+  population member IS the corresponding single run (equivalence pinned
+  at float tolerance by tests/test_hetero_sweep.py; over hundreds of
+  iterations the vmapped and single programs can drift apart through
+  fusion-level rounding on this chaotic objective, as any two
+  compilations of the same run can);
+- artifacts follow the sweep contract: per-member checkpoints under
+  ``{log_dir}/seed{i}/`` (standard single-run tooling plays them back)
+  plus ``sweep_summary.json``, so ``evaluate.py name=run`` ranks all
+  members and ``visualize_policy.py`` descends to the best member with
+  no new code.
+
+Deliberate scope (documented restrictions, enforced loudly):
+single-controller only (the config-5 acceptance runs on one chip; use
+``SweepTrainer`` for multi-host populations), no per-member learning
+rates, no ``iters_per_dispatch`` (stage boundaries are host-driven,
+same as ``HeteroTrainer``), and no mid-run resume — candidate runs are
+one-shot by design; the chip-window workflow restarts an interrupted
+candidate batch from scratch (`rm -rf` + retrain, scripts/chip_window.sh).
+An optional ``mesh={dp: D}`` shards the member axis over devices
+(``jax.shard_map``, K % D == 0), which is the 7th ``dryrun_multichip``
+path (__graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.training.train_state import TrainState
+
+from marl_distributedformation_tpu.algo import PPOConfig
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.env.hetero import (
+    hetero_compute_obs,
+    hetero_reset_batch,
+)
+from marl_distributedformation_tpu.models import MLPActorCritic
+from marl_distributedformation_tpu.train.curriculum import (
+    Curriculum,
+    CurriculumStage,
+    make_hetero_iteration,
+    sample_stage_counts,
+)
+from marl_distributedformation_tpu.train.trainer import (
+    TrainConfig,
+    fill_ent_schedule,
+)
+from marl_distributedformation_tpu.utils import (
+    MetricsLogger,
+    Throughput,
+    repo_root,
+    save_checkpoint,
+)
+
+Array = jax.Array
+
+
+class HeteroSweepTrainer:
+    """K candidate seeds of the hetero curriculum under one jit.
+
+    Args:
+      curriculum / env_params / ppo / config: as :class:`HeteroTrainer`.
+      num_seeds: population size K; member ``i`` trains at seed
+        ``config.seed + i``.
+      model: policy module shared across members (fresh params per
+        member); agent-factored MLP or per-formation CTDE.
+      mesh: optional ``jax.sharding.Mesh`` whose ``'dp'`` axis shards the
+        member axis (K must divide by it).
+    """
+
+    def __init__(
+        self,
+        curriculum: Curriculum = Curriculum(),
+        env_params: Optional[EnvParams] = None,
+        ppo: PPOConfig = PPOConfig(),
+        config: TrainConfig = TrainConfig(),
+        num_seeds: int = 4,
+        model: Any = None,
+        mesh: Any = None,
+    ) -> None:
+        assert num_seeds >= 1
+        if jax.process_count() > 1:
+            raise SystemExit(
+                "HeteroSweepTrainer is single-controller: the config-5 "
+                "candidate workflow runs on one chip. Multi-host "
+                "populations are SweepTrainer's domain (drop the "
+                "curriculum), or run one process."
+            )
+        if int(config.iters_per_dispatch) > 1:
+            raise SystemExit(
+                "iters_per_dispatch > 1 does not compose with curriculum "
+                "training (stage boundaries are host-driven); unset it"
+            )
+        if config.resume:
+            # Rejected BEFORE the K-member init below — there is nothing
+            # to resume into, and compiling the population just to bail
+            # would waste ~10s.
+            raise SystemExit(
+                "HeteroSweepTrainer has no mid-run resume: candidate "
+                "batches are one-shot (restart from scratch); resume a "
+                "single finished member via its seed{i}/ dir with the "
+                "plain curriculum trainer instead"
+            )
+        self.curriculum = curriculum
+        if env_params is None:
+            env_params = EnvParams()
+        self.env_params = env_params.replace(
+            num_agents=max(curriculum.max_agents, env_params.num_agents),
+            num_obstacles=max(
+                curriculum.max_obstacles, env_params.num_obstacles
+            ),
+        )
+        ppo = fill_ent_schedule(
+            ppo, self.env_params, config,
+            iterations=curriculum.total_rollouts,
+        )
+        self.ppo = ppo
+        self.config = config
+        self.num_seeds = num_seeds
+        self.model = model or MLPActorCritic(
+            act_dim=self.env_params.act_dim, log_std_init=ppo.log_std_init
+        )
+        self.per_formation = getattr(self.model, "per_formation", False)
+
+        if self.per_formation:
+            dummy_obs = jnp.zeros(
+                (1, self.env_params.num_agents, self.env_params.obs_dim),
+                jnp.float32,
+            )
+        else:
+            dummy_obs = jnp.zeros(
+                (1, self.env_params.obs_dim), jnp.float32
+            )
+        model_ref = self.model
+        tx = ppo.make_optimizer()
+
+        def init_member(seed: Array):
+            # EXACTLY HeteroTrainer.__init__'s key discipline so member i
+            # == HeteroTrainer(seed=config.seed + i) (same PRNG streams;
+            # equivalence pinned by tests/test_hetero_sweep.py).
+            key = jax.random.PRNGKey(seed)
+            key, k_init = jax.random.split(key)
+            params = model_ref.init(k_init, dummy_obs)
+            ts = TrainState.create(
+                apply_fn=model_ref.apply, params=params, tx=tx
+            )
+            return ts, key
+
+        self._mesh = mesh
+        if mesh is not None:
+            assert set(mesh.axis_names) == {"dp"}, (
+                f"hetero-sweep meshes shard the MEMBER axis over 'dp' "
+                f"only; got axes {tuple(mesh.axis_names)} (the padded "
+                "dynamic ring cannot shard the agent axis — see "
+                "HeteroTrainer)"
+            )
+            dp = int(mesh.shape["dp"])
+            assert num_seeds % dp == 0, (
+                f"num_seeds={num_seeds} must be divisible by the mesh dp "
+                f"axis ({dp})"
+            )
+
+        seeds = config.seed + jnp.arange(num_seeds)
+        self.train_state, self.key = jax.jit(jax.vmap(init_member))(seeds)
+
+        iteration = make_hetero_iteration(
+            self.env_params, ppo, self.per_formation
+        )
+        iteration_pop = jax.vmap(iteration)
+        if mesh is not None:
+            # shard_map over the member axis (not bare jit-under-mesh):
+            # members are independent, each device runs K/D of them
+            # entirely locally — provably zero collectives (the
+            # SweepTrainer rationale, train/sweep.py).
+            from jax.sharding import PartitionSpec
+
+            spec = PartitionSpec("dp")
+            iteration_pop = jax.shard_map(
+                iteration_pop,
+                mesh=mesh,
+                in_specs=spec,
+                out_specs=spec,
+                check_vma=False,
+            )
+        self._iteration = jax.jit(iteration_pop, donate_argnums=(0, 1))
+
+        self.env_state = None
+        self.obs = None
+        # Per-member active agent-transition counters (the SB3
+        # num_timesteps analog; members sample their own mixes, so the
+        # counts differ per member).
+        self.num_timesteps_members = np.zeros(num_seeds, np.int64)
+        self.completed_rollouts = 0
+        self._vec_steps_since_save = 0
+        self._active_agents = np.zeros(num_seeds, np.int64)
+        self.log_dir = config.log_dir or str(
+            repo_root() / "logs" / config.name
+        )
+
+    # ------------------------------------------------------------------
+
+    @property
+    def num_timesteps(self) -> int:
+        """Max over members — the checkpoint-naming / budget scalar (all
+        members advance the same rollout count; only their live agent
+        mixes differ)."""
+        return int(self.num_timesteps_members.max(initial=0))
+
+    @property
+    def total_timesteps(self) -> int:
+        """Per-member budget. NB when an explicit
+        ``config.total_timesteps`` BINDS before the curriculum finishes,
+        the whole population stops in LOCKSTEP once the FASTEST-counting
+        member (members sample their own mixes, so active-transition
+        counts differ) reaches it — slower members then see fewer
+        rollouts than their standalone single run would under the same
+        cap. The member == HeteroTrainer(seed+i) equivalence therefore
+        holds only for non-binding caps (the candidate workflow's case:
+        the cap is an upper bound, never attained with mixed stages)."""
+        if self.config.total_timesteps is not None:
+            return self.config.total_timesteps
+        return (
+            self.curriculum.total_rollouts
+            * self.ppo.n_steps
+            * self.config.num_formations
+            * self.env_params.num_agents
+        )
+
+    def start_stage(self, stage: CurriculumStage) -> None:
+        """Resample every member's formation mix and reset its envs —
+        the vmapped analog of ``HeteroTrainer.start_stage`` (each member
+        draws its OWN mix from its own key stream, preserving the
+        member == single-run equivalence)."""
+        m = self.config.num_formations
+        env_params = self.env_params
+
+        def member_stage(key: Array):
+            key, k_counts, k_env = jax.random.split(key, 3)
+            n_agents, n_obstacles = sample_stage_counts(k_counts, stage, m)
+            env_state = hetero_reset_batch(
+                k_env, env_params, n_agents, n_obstacles
+            )
+            obs = jax.vmap(hetero_compute_obs, in_axes=(0, None))(
+                env_state, env_params
+            )
+            return key, env_state, obs
+
+        self.key, self.env_state, self.obs = jax.jit(
+            jax.vmap(member_stage)
+        )(self.key)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            shard = NamedSharding(self._mesh, PartitionSpec("dp"))
+            place = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda x: jax.device_put(x, shard), t
+            )
+            self.train_state = place(self.train_state)
+            self.env_state = place(self.env_state)
+            self.obs = place(self.obs)
+            self.key = place(self.key)
+        # ONE host pull for the per-member active-agent counts.
+        self._active_agents = np.asarray(
+            jax.device_get(self.env_state.n_agents.sum(axis=-1)), np.int64
+        )
+
+    def run_iteration(self) -> Dict[str, Array]:
+        """One vectorized iteration; metric values carry a leading (K,)
+        member axis."""
+        assert self.env_state is not None, "call start_stage() first"
+        (
+            self.train_state,
+            self.env_state,
+            self.obs,
+            self.key,
+            metrics,
+        ) = self._iteration(
+            self.train_state, self.env_state, self.obs, self.key
+        )
+        self.num_timesteps_members += self.ppo.n_steps * self._active_agents
+        self.completed_rollouts += 1
+        self._vec_steps_since_save += self.ppo.n_steps
+        return metrics
+
+    def train(self) -> Dict[str, float]:
+        """Run the full curriculum for every member; logs population
+        aggregates per rollout (sweep metric contract: ``reward`` is the
+        population mean plus ``reward_best``/``reward_worst``/
+        ``best_seed``) and writes per-member checkpoints + the ranking
+        summary at the end."""
+        logger = MetricsLogger(
+            self.log_dir,
+            run_name=self.config.name,
+            use_wandb=self.config.use_wandb,
+            use_tensorboard=self.config.use_tensorboard,
+        )
+        meter = Throughput()
+        record: Dict[str, float] = {}
+        iteration = 0
+        metrics = None
+        done_budget = False
+        try:
+            for stage_idx, stage in enumerate(self.curriculum.stages):
+                if done_budget:
+                    break
+                self.start_stage(stage)
+                for _ in range(stage.rollouts):
+                    if (
+                        self.config.total_timesteps is not None
+                        and self.num_timesteps
+                        >= self.config.total_timesteps
+                    ):
+                        done_budget = True
+                        break
+                    metrics = self.run_iteration()
+                    iteration += 1
+                    meter.tick(
+                        self.ppo.n_steps
+                        * self.config.num_formations
+                        * self.num_seeds
+                    )
+                    if iteration % self.config.log_interval == 0:
+                        host = jax.device_get(metrics)  # one batched pull
+                        record = self._aggregate(host)
+                        record["env_steps_per_sec"] = meter.rate()
+                        record["curriculum_stage"] = float(stage_idx)
+                        logger.log(record, self.num_timesteps)
+                    if (
+                        self.config.checkpoint
+                        and self._vec_steps_since_save
+                        >= self.config.save_freq
+                    ):
+                        self.save()
+            if metrics is not None and self.config.checkpoint:
+                # Rank on the final iteration's rewards, matching the
+                # final checkpoints (the SweepTrainer rule).
+                final = jax.device_get(metrics)
+                self.save()
+                self._write_summary(np.asarray(final["reward"]))
+        finally:
+            logger.close()
+        return record
+
+    def _aggregate(self, host: Dict[str, np.ndarray]) -> Dict[str, float]:
+        rewards = np.asarray(host["reward"])
+        record = {k: float(np.mean(v)) for k, v in host.items()}
+        record["reward_best"] = float(rewards.max())
+        record["reward_worst"] = float(rewards.min())
+        record["best_seed"] = int(self.config.seed + rewards.argmax())
+        return record
+
+    def save(self) -> None:
+        """Per-member checkpoints under ``{log_dir}/seed{i}/`` — each
+        plays back / fine-tunes through the standard single-run tooling
+        (``visualize_policy.py name={name}/seed{i}``). One batched device
+        pull serves every member (tunneled-TPU rule: sync once, slice on
+        host)."""
+        host = jax.device_get(
+            {
+                "params": self.train_state.params,
+                "opt_state": self.train_state.opt_state,
+                "key": self.key,
+            }
+        )
+        for i in range(self.num_seeds):
+            # np.array: owning copies, not views keeping the full
+            # population tree alive (the SweepTrainer.member_state rule).
+            take = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda x: np.array(x[i]), t
+            )
+            state = {
+                "policy": self.model.__class__.__name__,
+                "params": take(host["params"]),
+                "opt_state": take(host["opt_state"]),
+                "key": np.array(host["key"][i]),
+                "num_timesteps": int(self.num_timesteps_members[i]),
+                "completed_rollouts": self.completed_rollouts,
+            }
+            save_checkpoint(
+                Path(self.log_dir) / f"seed{i}",
+                int(self.num_timesteps_members[i]),
+                state,
+                sync=False,
+            )
+        self._vec_steps_since_save = 0
+
+    def _write_summary(self, rewards: np.ndarray) -> None:
+        summary = {
+            "seeds": [
+                int(self.config.seed + i) for i in range(self.num_seeds)
+            ],
+            "final_reward": [float(r) for r in rewards],
+            "best_seed": int(self.config.seed + rewards.argmax()),
+            "best_dir": f"seed{int(rewards.argmax())}",
+            "curriculum_rollouts": self.curriculum.total_rollouts,
+        }
+        path = Path(self.log_dir) / "sweep_summary.json"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(summary, indent=2))

@@ -96,12 +96,15 @@ def build_trainer(cfg) -> Trainer:
             "number of rates (one member per rate)"
         )
     if cfg.get("curriculum"):
-        if num_seeds > 1:
+        if num_seeds > 1 and learning_rates:
             raise SystemExit(
-                "num_seeds > 1 does not compose with curriculum training; "
-                "run the sweep on a fixed stage instead"
+                "learning_rates does not compose with curriculum "
+                "populations (candidate-seed selection trains at one "
+                "rate); drop one of the two"
             )
-        return build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn)
+        return build_hetero_trainer(
+            cfg, env_params, ppo, train_cfg, shard_fn, num_seeds
+        )
     policy = cfg.get("policy", "mlp")
     model = None
     if policy == "ctde":
@@ -146,9 +149,13 @@ def build_trainer(cfg) -> Trainer:
     )
 
 
-def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn):
+def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn,
+                         num_seeds: int = 1):
     """Curriculum path (BASELINE.json config 5): mixed-size padded formations
-    with an obstacle field, staged over ``cfg.curriculum``."""
+    with an obstacle field, staged over ``cfg.curriculum``. With
+    ``num_seeds > 1``, K candidate seeds of the full curriculum train in
+    one vmapped program (train/hetero_sweep.py) — the det-gate candidate
+    selection workflow (docs/acceptance/hetero5/)."""
     from marl_distributedformation_tpu.train import (
         HeteroTrainer,
         curriculum_from_cfg,
@@ -176,6 +183,18 @@ def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn):
             act_dim=env_params.act_dim, log_std_init=cfg.log_std_init
         )
     curriculum = curriculum_from_cfg(cfg.curriculum)
+    if num_seeds > 1:
+        from marl_distributedformation_tpu.train import HeteroSweepTrainer
+
+        return HeteroSweepTrainer(
+            curriculum=curriculum,
+            env_params=env_params,
+            ppo=ppo,
+            config=train_cfg,
+            num_seeds=num_seeds,
+            model=model,
+            mesh=getattr(shard_fn, "mesh", None),
+        )
     return HeteroTrainer(
         curriculum=curriculum,
         env_params=env_params,
