@@ -46,7 +46,6 @@ path (__graft_entry__.py).
 
 from __future__ import annotations
 
-import json
 from pathlib import Path
 from typing import Any, Dict, Optional
 
@@ -67,6 +66,10 @@ from marl_distributedformation_tpu.train.curriculum import (
     CurriculumStage,
     make_hetero_iteration,
     sample_stage_counts,
+)
+from marl_distributedformation_tpu.train.sweep import (
+    population_aggregate,
+    write_sweep_summary,
 )
 from marl_distributedformation_tpu.train.trainer import (
     TrainConfig,
@@ -369,12 +372,7 @@ class HeteroSweepTrainer:
         return record
 
     def _aggregate(self, host: Dict[str, np.ndarray]) -> Dict[str, float]:
-        rewards = np.asarray(host["reward"])
-        record = {k: float(np.mean(v)) for k, v in host.items()}
-        record["reward_best"] = float(rewards.max())
-        record["reward_worst"] = float(rewards.min())
-        record["best_seed"] = int(self.config.seed + rewards.argmax())
-        return record
+        return population_aggregate(host, self.config.seed)
 
     def save(self) -> None:
         """Per-member checkpoints under ``{log_dir}/seed{i}/`` — each
@@ -412,15 +410,10 @@ class HeteroSweepTrainer:
         self._vec_steps_since_save = 0
 
     def _write_summary(self, rewards: np.ndarray) -> None:
-        summary = {
-            "seeds": [
-                int(self.config.seed + i) for i in range(self.num_seeds)
-            ],
-            "final_reward": [float(r) for r in rewards],
-            "best_seed": int(self.config.seed + rewards.argmax()),
-            "best_dir": f"seed{int(rewards.argmax())}",
-            "curriculum_rollouts": self.curriculum.total_rollouts,
-        }
-        path = Path(self.log_dir) / "sweep_summary.json"
-        path.parent.mkdir(parents=True, exist_ok=True)
-        path.write_text(json.dumps(summary, indent=2))
+        write_sweep_summary(
+            self.log_dir,
+            self.config.seed,
+            self.num_seeds,
+            rewards,
+            {"curriculum_rollouts": self.curriculum.total_rollouts},
+        )

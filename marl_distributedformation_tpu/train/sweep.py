@@ -677,34 +677,57 @@ class SweepTrainer:
         return record
 
     def _aggregate(self, host: Dict[str, np.ndarray]) -> Dict[str, float]:
-        """Population means under the CANONICAL metric names (the
-        reference metric-name contract, utils/logging.py — so JSONL
-        consumers and the stdout brief keep working), plus population
-        spread fields."""
-        rewards = np.asarray(host["reward"])
-        record = {k: float(np.mean(v)) for k, v in host.items()}
-        record["reward_best"] = float(rewards.max())
-        record["reward_worst"] = float(rewards.min())
-        record["best_seed"] = int(self.config.seed + rewards.argmax())
-        return record
+        return population_aggregate(host, self.config.seed)
 
     def _write_summary(self, rewards: Optional[np.ndarray]) -> None:
         from marl_distributedformation_tpu.parallel import is_coordinator
 
         if rewards is None or not is_coordinator():
             return
-        summary = {
-            "seeds": [
-                int(self.config.seed + i) for i in range(self.num_seeds)
-            ],
-            "final_reward": [float(r) for r in rewards],
-            "best_seed": int(self.config.seed + rewards.argmax()),
-            "best_dir": f"seed{int(rewards.argmax())}",
-        }
+        extra = None
         if self._lrs_host is not None:
-            summary["learning_rates"] = [
-                float(lr) for lr in self._lrs_host
-            ]
-        path = Path(self.log_dir) / "sweep_summary.json"
-        path.parent.mkdir(parents=True, exist_ok=True)
-        path.write_text(json.dumps(summary, indent=2))
+            extra = {
+                "learning_rates": [float(lr) for lr in self._lrs_host]
+            }
+        write_sweep_summary(
+            self.log_dir, self.config.seed, self.num_seeds, rewards, extra
+        )
+
+
+def population_aggregate(
+    host: Dict[str, np.ndarray], seed0: int
+) -> Dict[str, float]:
+    """Population means under the CANONICAL metric names (the reference
+    metric-name contract, utils/logging.py — so JSONL consumers and the
+    stdout brief keep working), plus population spread fields. The
+    single sweep metric contract — shared by ``SweepTrainer`` and
+    ``HeteroSweepTrainer`` so the two cannot drift."""
+    rewards = np.asarray(host["reward"])
+    record = {k: float(np.mean(v)) for k, v in host.items()}
+    record["reward_best"] = float(rewards.max())
+    record["reward_worst"] = float(rewards.min())
+    record["best_seed"] = int(seed0 + rewards.argmax())
+    return record
+
+
+def write_sweep_summary(
+    log_dir,
+    seed0: int,
+    num_seeds: int,
+    rewards: np.ndarray,
+    extra: Optional[Dict[str, Any]] = None,
+) -> None:
+    """The ``sweep_summary.json`` artifact contract (consumed by
+    evaluate.py's member ranking and visualize_policy.py's best-member
+    descent) — shared by both population trainers."""
+    summary = {
+        "seeds": [int(seed0 + i) for i in range(num_seeds)],
+        "final_reward": [float(r) for r in rewards],
+        "best_seed": int(seed0 + rewards.argmax()),
+        "best_dir": f"seed{int(rewards.argmax())}",
+    }
+    if extra:
+        summary.update(extra)
+    path = Path(log_dir) / "sweep_summary.json"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(summary, indent=2))

@@ -432,11 +432,15 @@ hetero5_stage() {
   attempt=$(cat docs/acceptance/hetero5/seed_attempt 2>/dev/null || echo 0)
   echo "[hetero5] training candidate block $attempt" \
        "(seeds $((attempt * HETERO5_CANDIDATES))..$(((attempt + 1) * HETERO5_CANDIDATES - 1)))"
+  # save_freq=1000: the default (10 vec-steps = every rollout) would pay
+  # ~200 population device-pulls over the tunnel just for intermediate
+  # checkpoints nobody reads — the final save (+1 midpoint) suffices,
+  # the selection evaluates final checkpoints only.
   python train.py name=hetero5_tpu num_seeds="$HETERO5_CANDIDATES" \
     seed=$((attempt * HETERO5_CANDIDATES)) num_formation=64 \
     num_agents_per_formation=20 preset=tpu total_timesteps=2560000 \
     ent_coef_final=0.0 log_std_final=-2.5 log_std_decay_start=0.5 \
-    use_wandb=false \
+    use_wandb=false save_freq=1000 \
     "curriculum=[{rollouts: 30, agent_counts: [5]}, {rollouts: 40, agent_counts: [5, 5, 20]}, {rollouts: 30, agent_counts: [5, 5, 20], num_obstacles: 4}, {rollouts: 100, agent_counts: [5, 5, 20], num_obstacles: 4}]" \
     || return 1
   # Platform gate only — the stamp means "candidates trained on the

@@ -588,12 +588,17 @@ def test_cli_dispatch(tmp_path, monkeypatch):
     trainer = train_cli.build_trainer(cfg)
     assert isinstance(trainer, SweepTrainer)
 
+    # num_seeds now COMPOSES with curriculum (round 5): the candidate
+    # population trainer — its own dispatch/rejection matrix is pinned
+    # in tests/test_hetero_sweep.py::test_cli_dispatch.
+    from marl_distributedformation_tpu.train import HeteroSweepTrainer
+
     cfg2 = load_config(
-        ["name=x", "num_seeds=2", "platform=cpu",
+        ["name=x", "num_seeds=2", "platform=cpu", "num_formation=4",
+         "num_agents_per_formation=3",
          "curriculum=[{rollouts: 2, agent_counts: [3]}]"]
     )
-    with pytest.raises(SystemExit, match="curriculum"):
-        train_cli.build_trainer(cfg2)
+    assert isinstance(train_cli.build_trainer(cfg2), HeteroSweepTrainer)
 
     # resume=true now composes with sweeps (population resume): with no
     # prior sweep_state it just builds a fresh population.
