@@ -238,7 +238,6 @@ class HeteroTrainer:
         )
 
 
-
     # ------------------------------------------------------------------
     # Imperative shell
     # ------------------------------------------------------------------
@@ -438,8 +437,6 @@ class HeteroTrainer:
             f"[hetero] resumed at {self.num_timesteps} steps "
             f"({self.completed_rollouts} rollouts)"
         )
-
-
 
 
 def make_hetero_iteration(env_params, ppo, per_formation: bool):
