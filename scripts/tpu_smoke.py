@@ -7,8 +7,9 @@ that hardware checks only help if they actually get run. This script is
 the broad companion to tests/tpu_compiled_parity.py's deep k-NN check:
 it drives one full jitted training iteration of every path the framework
 ships — MLP (parity + preset=tpu batch), CTDE, knn+GNN (Pallas kernel
-live), the heterogeneous curriculum, and a seed population — and prints
-one SMOKE_OK/SMOKE_FAIL line each. Run via scripts/chip_checks.sh or:
+live), the heterogeneous curriculum, a seed population, and the
+hetero-curriculum candidate population (the config-5 selection
+workflow) — and prints one SMOKE_OK/SMOKE_FAIL line each. Run via scripts/chip_checks.sh or:
 
     python scripts/tpu_smoke.py        # ~2-3 min incl. compiles
     python scripts/tpu_smoke.py cpu    # off-chip smoke of the script itself
@@ -40,6 +41,7 @@ SMOKE_PATHS = (
     "gnn_swarm1024",
     "hetero_curriculum",
     "sweep_k4",
+    "hetero_pop",
 )
 
 
@@ -57,6 +59,7 @@ def run_paths(m: int = 256, only: list[str] | None = None) -> dict:
     from marl_distributedformation_tpu.train import (
         Curriculum,
         CurriculumStage,
+        HeteroSweepTrainer,
         HeteroTrainer,
         SweepTrainer,
         TrainConfig,
@@ -129,30 +132,48 @@ def run_paths(m: int = 256, only: list[str] | None = None) -> dict:
         )
     )
 
-    def hetero_path():
-        trainer = HeteroTrainer(
-            curriculum=Curriculum(
-                stages=(
-                    CurriculumStage(rollouts=1, agent_counts=(5,)),
-                    CurriculumStage(
-                        rollouts=1, agent_counts=(5, 20), num_obstacles=2
-                    ),
-                )
+    # ONE smoke curriculum + stage walk shared by both hetero paths so
+    # they cannot drift apart.
+    smoke_curriculum = Curriculum(
+        stages=(
+            CurriculumStage(rollouts=1, agent_counts=(5,)),
+            CurriculumStage(
+                rollouts=1, agent_counts=(5, 20), num_obstacles=2
             ),
-            env_params=EnvParams(num_agents=5, max_steps=64),
-            config=cfg("hetero", max(m // 8, 8)),
         )
+    )
+
+    def walk_curriculum(trainer):
         total = 0.0
         for stage in trainer.curriculum.stages:
             trainer.start_stage(stage)
             total += one_iteration(trainer)
         return total
 
-    paths["hetero_curriculum"] = hetero_path
+    paths["hetero_curriculum"] = lambda: walk_curriculum(
+        HeteroTrainer(
+            curriculum=smoke_curriculum,
+            env_params=EnvParams(num_agents=5, max_steps=64),
+            config=cfg("hetero", max(m // 8, 8)),
+        )
+    )
     paths["sweep_k4"] = lambda: one_iteration(
         SweepTrainer(
             EnvParams(num_agents=5), config=cfg("sweep", max(m // 4, 8)),
             num_seeds=4,
+        )
+    )
+
+    # Candidate-seed population of the curriculum (round 5,
+    # train/hetero_sweep.py — the config-5 selection workflow), incl.
+    # the noise-decay schedule it ships with and a stage transition.
+    paths["hetero_pop"] = lambda: walk_curriculum(
+        HeteroSweepTrainer(
+            curriculum=smoke_curriculum,
+            env_params=EnvParams(num_agents=5, max_steps=64),
+            ppo=PPOConfig(ent_coef_final=0.0, log_std_final=-2.5),
+            config=cfg("hetero-pop", max(m // 16, 4)),
+            num_seeds=2,
         )
     )
 
