@@ -42,6 +42,28 @@ def model_kwargs_for(policy: str, env_params=None) -> dict:
     return {}
 
 
+def infer_hidden(params: dict, policy: str) -> Optional[tuple]:
+    """Infer the policy-tower widths from checkpoint parameters, so
+    checkpoints trained with non-default ``hidden_sizes`` (the SB3
+    policy_kwargs/net_arch analog, cfg ``hidden_sizes``) restore without
+    the caller re-supplying the architecture. The tower layers are named
+    ``pi_{i}`` — at the top level for the plain MLP, under ``actor`` for
+    the PolicyHead-based CTDE/GNN models. Returns None when no tower is
+    found (leave the model's default)."""
+    p = params
+    if policy in ("CTDEActorCritic", "GNNActorCritic"):
+        p = params.get("actor", {})
+    widths = []
+    i = 0
+    while f"pi_{i}" in p:
+        kernel = p[f"pi_{i}"].get("kernel")
+        if kernel is None:
+            return None
+        widths.append(int(np.shape(kernel)[-1]))
+        i += 1
+    return tuple(widths) or None
+
+
 def load_checkpoint_raw(path: str | Path) -> dict:
     """Restore a checkpoint file into nested dicts without a template."""
     return serialization.msgpack_restore(Path(path).read_bytes())
@@ -92,12 +114,16 @@ class LoadedPolicy:
         policy = raw.get("policy", "MLPActorCritic")
         if num_agents is None and env_params is not None:
             num_agents = env_params.num_agents
+        kwargs = model_kwargs_for(policy, env_params)
+        hidden = infer_hidden(raw["params"]["params"], policy)
+        if hidden:
+            kwargs["hidden"] = hidden
         return cls(
             {"params": raw["params"]["params"]},
             act_dim=act_dim,
             policy=policy,
             num_agents=num_agents,
-            model_kwargs=model_kwargs_for(policy, env_params),
+            model_kwargs=kwargs,
         )
 
     def predict(

@@ -248,3 +248,50 @@ def test_metrics_logger_tensorboard(tmp_path):
     assert any(
         f.name.startswith("events.out.tfevents") for f in tb_dir.iterdir()
     )
+
+
+def test_loaded_policy_infers_nondefault_hidden(tmp_path):
+    """Checkpoints trained with hidden_sizes != the 'MlpPolicy' default
+    restore through playback/eval: LoadedPolicy infers the tower widths
+    from the parameter shapes (the checkpoint records only the class
+    name)."""
+    import jax.numpy as jnp
+
+    from marl_distributedformation_tpu.models import MLPActorCritic
+    from marl_distributedformation_tpu.utils import save_checkpoint
+
+    model = MLPActorCritic(act_dim=2, hidden=(32, 16))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    save_checkpoint(
+        tmp_path, 10,
+        {"policy": "MLPActorCritic", "params": params, "num_timesteps": 10},
+    )
+    pol = LoadedPolicy.from_checkpoint(latest_checkpoint(tmp_path))
+    assert tuple(pol.model.hidden) == (32, 16)
+    obs = np.zeros((4, 8), np.float32)
+    actions, _ = pol.predict(obs, deterministic=True)
+    mean, _, _ = model.apply(params, jnp.asarray(obs))
+    np.testing.assert_allclose(
+        actions, np.clip(np.asarray(mean), -1, 1), atol=1e-6
+    )
+
+    # Nested-actor models (PolicyHead under "actor"): the CTDE tower
+    # widths infer through the nesting too.
+    from marl_distributedformation_tpu.models import CTDEActorCritic
+    from marl_distributedformation_tpu.utils import save_checkpoint as save2
+
+    cmodel = CTDEActorCritic(act_dim=2, hidden=(24, 12))
+    cparams = cmodel.init(jax.random.PRNGKey(1), jnp.zeros((1, 3, 8)))
+    cdir = tmp_path / "ctde"
+    save2(
+        cdir, 10,
+        {"policy": "CTDEActorCritic", "params": cparams,
+         "num_timesteps": 10},
+    )
+    cpol = LoadedPolicy.from_checkpoint(
+        latest_checkpoint(cdir), num_agents=3
+    )
+    assert tuple(cpol.model.hidden) == (24, 12)
+    cobs = np.zeros((6, 8), np.float32)  # (M*N, obs) flat SB3 rows
+    cacts, _ = cpol.predict(cobs, deterministic=True)
+    assert cacts.shape == (6, 2)

@@ -103,3 +103,18 @@ def test_ppo_from_config_schedule_knobs_forwarded():
     ppo = train_cli.ppo_from_config(cfg)
     assert ppo.log_std_final == -2.5
     assert ppo.log_std_decay_start == 0.5
+
+
+def test_hidden_sizes_knob():
+    """hidden_sizes=[...] (the SB3 policy_kwargs/net_arch analog) reaches
+    the constructed model; null keeps the reference 'MlpPolicy' default."""
+    cfg = load_config(
+        ["name=x", "hidden_sizes=[128,128]", "num_formation=4",
+         "num_agents_per_formation=3"]
+    )
+    trainer = train_cli.build_trainer(cfg)
+    assert tuple(trainer.model.hidden) == (128, 128)
+    cfg2 = load_config(
+        ["name=x", "num_formation=4", "num_agents_per_formation=3"]
+    )
+    assert tuple(train_cli.build_trainer(cfg2).model.hidden) == (64, 64)

@@ -63,6 +63,62 @@ def train_config_from_config(cfg) -> TrainConfig:
     )
 
 
+def _hidden_sizes(cfg):
+    """Optional ``hidden_sizes=[w1, w2, ...]`` — the SB3
+    ``policy_kwargs={'net_arch': ...}`` analog (the reference uses the
+    'MlpPolicy' default [64, 64]; this knob replaces that part of SB3's
+    constructor surface). None/null keeps each model's default."""
+    sizes = cfg.get("hidden_sizes")
+    if not sizes:
+        return None
+    return tuple(int(w) for w in sizes)
+
+
+def build_model(cfg, env_params, policy: str):
+    """The ONE policy-module construction site (both the plain and the
+    curriculum trainer paths build through here): maps the ``policy``
+    name + config knobs (``hidden_sizes``, ``log_std_init``, knn
+    geometry) to a model instance, or None for the default-shape MLP
+    (trainer shells construct that themselves)."""
+    hidden = _hidden_sizes(cfg)
+    extra = {"hidden": hidden} if hidden else {}
+    if policy == "ctde":
+        from marl_distributedformation_tpu.models import CTDEActorCritic
+
+        return CTDEActorCritic(
+            act_dim=env_params.act_dim, log_std_init=cfg.log_std_init,
+            **extra,
+        )
+    if policy == "gnn":
+        if env_params.obs_mode != "knn":
+            raise SystemExit(
+                "policy=gnn needs the k-NN observation graph: set "
+                "obs_mode=knn (and knn_k) in the config"
+            )
+        from marl_distributedformation_tpu.models import GNNActorCritic
+
+        return GNNActorCritic(
+            k=env_params.knn_k,
+            act_dim=env_params.act_dim,
+            goal_in_obs=env_params.goal_in_obs,
+            log_std_init=cfg.log_std_init,
+            **extra,
+        )
+    if policy == "mlp":
+        if not hidden:
+            return None
+        from marl_distributedformation_tpu.models import MLPActorCritic
+
+        return MLPActorCritic(
+            act_dim=env_params.act_dim,
+            hidden=hidden,
+            log_std_init=cfg.log_std_init,
+        )
+    raise SystemExit(
+        f"policy={policy!r} is not implemented; available: mlp, ctde, gnn"
+    )
+
+
 def shard_fn_from_config(cfg):
     if not cfg.get("mesh"):
         return None
@@ -106,32 +162,7 @@ def build_trainer(cfg) -> Trainer:
             cfg, env_params, ppo, train_cfg, shard_fn, num_seeds
         )
     policy = cfg.get("policy", "mlp")
-    model = None
-    if policy == "ctde":
-        from marl_distributedformation_tpu.models import CTDEActorCritic
-
-        model = CTDEActorCritic(
-            act_dim=env_params.act_dim, log_std_init=cfg.log_std_init
-        )
-    elif policy == "gnn":
-        if env_params.obs_mode != "knn":
-            raise SystemExit(
-                "policy=gnn needs the k-NN observation graph: set "
-                "obs_mode=knn (and knn_k) in the config"
-            )
-        from marl_distributedformation_tpu.models import GNNActorCritic
-
-        model = GNNActorCritic(
-            k=env_params.knn_k,
-            act_dim=env_params.act_dim,
-            goal_in_obs=env_params.goal_in_obs,
-            log_std_init=cfg.log_std_init,
-        )
-    elif policy != "mlp":
-        raise SystemExit(
-            f"policy={cfg.policy!r} is not implemented; available: "
-            "mlp, ctde, gnn"
-        )
+    model = build_model(cfg, env_params, policy)
     if num_seeds > 1:
         from marl_distributedformation_tpu.train import SweepTrainer
 
@@ -175,13 +206,7 @@ def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn,
             f"formations mask the ring per transition); obs_mode="
             f"{env_params.obs_mode!r} is not supported — set obs_mode=ring"
         )
-    model = None
-    if policy == "ctde":
-        from marl_distributedformation_tpu.models import CTDEActorCritic
-
-        model = CTDEActorCritic(
-            act_dim=env_params.act_dim, log_std_init=cfg.log_std_init
-        )
+    model = build_model(cfg, env_params, policy)
     curriculum = curriculum_from_cfg(cfg.curriculum)
     if num_seeds > 1:
         from marl_distributedformation_tpu.train import HeteroSweepTrainer
