@@ -35,10 +35,14 @@ Composition of two existing shells, not new machinery:
 Deliberate scope (documented restrictions, enforced loudly):
 single-controller only (the config-5 acceptance runs on one chip; use
 ``SweepTrainer`` for multi-host populations), no per-member learning
-rates, no ``iters_per_dispatch`` (stage boundaries are host-driven,
-same as ``HeteroTrainer``), and no mid-run resume — candidate runs are
-one-shot by design; the chip-window workflow restarts an interrupted
-candidate batch from scratch (`rm -rf` + retrain, scripts/chip_window.sh).
+rates, and no ``iters_per_dispatch`` (stage boundaries are host-driven,
+same as ``HeteroTrainer``). ``resume=true`` restores the latest
+``sweep_state_*`` population checkpoint — params, batched optimizer
+state, member PRNG streams, env state, per-member counters, and the
+curriculum cursor — and continues bit-identically to an uninterrupted
+run, including MID-stage (the partially-walked stage is not resampled).
+Operationally critical on the short-window tunneled chip, where the
+K-candidate curriculum is the longest stage in the validation queue.
 An optional ``mesh={dp: D}`` shards the member axis over devices
 (``jax.shard_map``, K % D == 0), which is the 7th ``dryrun_multichip``
 path (__graft_entry__.py).
@@ -78,8 +82,10 @@ from marl_distributedformation_tpu.train.trainer import (
 from marl_distributedformation_tpu.utils import (
     MetricsLogger,
     Throughput,
+    latest_sweep_state,
     repo_root,
     save_checkpoint,
+    save_sweep_state,
 )
 
 Array = jax.Array
@@ -120,16 +126,6 @@ class HeteroSweepTrainer:
             raise SystemExit(
                 "iters_per_dispatch > 1 does not compose with curriculum "
                 "training (stage boundaries are host-driven); unset it"
-            )
-        if config.resume:
-            # Rejected BEFORE the K-member init below — there is nothing
-            # to resume into, and compiling the population just to bail
-            # would waste ~10s.
-            raise SystemExit(
-                "HeteroSweepTrainer has no mid-run resume: candidate "
-                "batches are one-shot (restart from scratch); resume a "
-                "single finished member via its seed{i}/ dir with the "
-                "plain curriculum trainer instead"
             )
         self.curriculum = curriculum
         if env_params is None:
@@ -226,6 +222,14 @@ class HeteroSweepTrainer:
         self.log_dir = config.log_dir or str(
             repo_root() / "logs" / config.name
         )
+        if config.resume:
+            # Restore BEFORE mesh placement (start_stage re-places) —
+            # exactly the SweepTrainer ordering. An interrupted candidate
+            # block continues bit-identically instead of retraining from
+            # scratch: operationally critical on the short-window
+            # tunneled chip, where the K-candidate curriculum is the
+            # longest single stage in the validation queue.
+            self._try_resume()
 
     # ------------------------------------------------------------------
 
@@ -256,11 +260,12 @@ class HeteroSweepTrainer:
             * self.env_params.num_agents
         )
 
-    def start_stage(self, stage: CurriculumStage) -> None:
-        """Resample every member's formation mix and reset its envs —
-        the vmapped analog of ``HeteroTrainer.start_stage`` (each member
-        draws its OWN mix from its own key stream, preserving the
-        member == single-run equivalence)."""
+    def _member_stage_fn(self, stage: CurriculumStage):
+        """Per-member stage reset ``key -> (key, env_state, obs)`` — the
+        ONE definition of the stage key-split/reset/obs discipline, used
+        live by ``start_stage`` and shape-only (``jax.eval_shape``) by
+        ``_state_template`` so the resume template cannot drift from the
+        real state structure."""
         m = self.config.num_formations
         env_params = self.env_params
 
@@ -275,20 +280,36 @@ class HeteroSweepTrainer:
             )
             return key, env_state, obs
 
-        self.key, self.env_state, self.obs = jax.jit(
-            jax.vmap(member_stage)
-        )(self.key)
-        if self._mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
+        return member_stage
 
-            shard = NamedSharding(self._mesh, PartitionSpec("dp"))
-            place = lambda t: jax.tree_util.tree_map(  # noqa: E731
-                lambda x: jax.device_put(x, shard), t
-            )
-            self.train_state = place(self.train_state)
-            self.env_state = place(self.env_state)
-            self.obs = place(self.obs)
-            self.key = place(self.key)
+    def start_stage(self, stage: CurriculumStage) -> None:
+        """Resample every member's formation mix and reset its envs —
+        the vmapped analog of ``HeteroTrainer.start_stage`` (each member
+        draws its OWN mix from its own key stream, preserving the
+        member == single-run equivalence)."""
+        self.key, self.env_state, self.obs = jax.jit(
+            jax.vmap(self._member_stage_fn(stage))
+        )(self.key)
+        self._place_on_mesh()
+        self._refresh_active_agents()
+
+    def _place_on_mesh(self) -> None:
+        """(Re-)place the whole population on the dp mesh — after a stage
+        reset or a resume restore; no-op unmeshed."""
+        if self._mesh is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        shard = NamedSharding(self._mesh, PartitionSpec("dp"))
+        place = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jax.device_put(x, shard), t
+        )
+        self.train_state = place(self.train_state)
+        self.env_state = place(self.env_state)
+        self.obs = place(self.obs)
+        self.key = place(self.key)
+
+    def _refresh_active_agents(self) -> None:
         # ONE host pull for the per-member active-agent counts.
         self._active_agents = np.asarray(
             jax.device_get(self.env_state.n_agents.sum(axis=-1)), np.int64
@@ -326,15 +347,30 @@ class HeteroSweepTrainer:
         )
         meter = Throughput()
         record: Dict[str, float] = {}
-        iteration = 0
+        # Resume continuity: the log_interval cadence is phased on the
+        # GLOBAL rollout index, so a resumed run logs the same rollouts
+        # an uninterrupted one would.
+        iteration = self.completed_rollouts
         metrics = None
         done_budget = False
         try:
+            stage_end = 0
             for stage_idx, stage in enumerate(self.curriculum.stages):
                 if done_budget:
                     break
-                self.start_stage(stage)
-                for _ in range(stage.rollouts):
+                stage_start = stage_end
+                stage_end = stage_start + stage.rollouts
+                if self.completed_rollouts >= stage_end:
+                    continue  # resumed past this stage — don't replay it
+                if (
+                    self.completed_rollouts == stage_start
+                    or self.env_state is None
+                ):
+                    self.start_stage(stage)
+                # else: resumed MID-stage — env/counters restored by
+                # _try_resume; re-running start_stage would resample the
+                # stage and break bit-exact continuation.
+                for _ in range(stage_end - self.completed_rollouts):
                     if (
                         self.config.total_timesteps is not None
                         and self.num_timesteps
@@ -385,6 +421,8 @@ class HeteroSweepTrainer:
                 "params": self.train_state.params,
                 "opt_state": self.train_state.opt_state,
                 "key": self.key,
+                "env_state": self.env_state,
+                "obs": self.obs,
             }
         )
         for i in range(self.num_seeds):
@@ -407,7 +445,144 @@ class HeteroSweepTrainer:
                 state,
                 sync=False,
             )
+        # ONE population-state file so an interrupted block RESUMES
+        # (resume=true) mid-curriculum instead of retraining from
+        # scratch — the identity fields are validated on restore.
+        save_sweep_state(
+            self.log_dir,
+            self.num_timesteps,
+            {
+                "policy": self.model.__class__.__name__,
+                "num_seeds": self.num_seeds,
+                "seed": int(self.config.seed),
+                "num_formations": int(self.config.num_formations),
+                "curriculum_spec": self._curriculum_spec(),
+                "num_timesteps_members": np.asarray(
+                    self.num_timesteps_members
+                ),
+                "completed_rollouts": self.completed_rollouts,
+                **{
+                    k: host[k]
+                    for k in ("params", "opt_state", "key",
+                              "env_state", "obs")
+                },
+            },
+        )
         self._vec_steps_since_save = 0
+
+    def _state_template(self):
+        """Host-side zero template with the population shapes — env/obs
+        shapes come from ``jax.eval_shape`` over the SAME stage-reset
+        function ``start_stage`` runs (no PRNG is consumed, no device
+        compute runs)."""
+        _, env_shape, obs_shape = jax.eval_shape(
+            jax.vmap(self._member_stage_fn(self.curriculum.stages[0])),
+            self.key,
+        )
+        zeros = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: np.zeros(x.shape, x.dtype), t
+        )
+        return {
+            "params": zeros(self.train_state.params),
+            "opt_state": zeros(self.train_state.opt_state),
+            "key": zeros(self.key),
+            "env_state": zeros(env_shape),
+            "obs": zeros(obs_shape),
+        }
+
+    def _curriculum_spec(self) -> str:
+        """Canonical string of the full stage structure for the resume
+        identity check (msgpack-friendly; compared verbatim)."""
+        return repr(
+            [
+                (s.rollouts, tuple(s.agent_counts),
+                 None if s.probs is None else tuple(s.probs),
+                 s.num_obstacles)
+                for s in self.curriculum.stages
+            ]
+        )
+
+    def _try_resume(self) -> None:
+        """Restore the latest ``sweep_state_*`` population checkpoint:
+        params, batched optimizer state, member PRNG streams, env state,
+        per-member transition counters, and the curriculum cursor — the
+        resumed run continues bit-identically to an uninterrupted one
+        (pinned by tests/test_hetero_sweep.py)."""
+        from flax import serialization
+
+        path = latest_sweep_state(self.log_dir)
+        if path is None:
+            print(
+                "[hetero-sweep] resume=true but no sweep_state_* "
+                f"population checkpoint under {self.log_dir}; starting "
+                "fresh"
+            )
+            return
+        raw = serialization.msgpack_restore(Path(path).read_bytes())
+        ident = {
+            "policy": self.model.__class__.__name__,
+            "num_seeds": self.num_seeds,
+            "seed": int(self.config.seed),
+            "num_formations": int(self.config.num_formations),
+            # The FULL stage structure, not just the rollout total — a
+            # reshuffled curriculum with the same total would otherwise
+            # resume onto wrong stage boundaries.
+            "curriculum_spec": self._curriculum_spec(),
+        }
+        for field, want in ident.items():
+            got = raw.get(field)
+            if got != want and str(got) != str(want):
+                raise SystemExit(
+                    f"hetero-sweep resume mismatch: {path} was written "
+                    f"with {field}={got!r} but this run uses {want!r} — "
+                    "candidate identities would silently change"
+                )
+        template = self._state_template()
+        for name in (*template, "num_timesteps_members",
+                     "completed_rollouts"):
+            if name not in raw:
+                raise SystemExit(
+                    f"hetero-sweep resume: {path} is missing {name!r} — "
+                    "truncated or foreign file"
+                )
+        restored = {
+            name: serialization.from_state_dict(tmpl, raw[name])
+            for name, tmpl in template.items()
+        }
+        self.train_state = self.train_state.replace(
+            params=restored["params"], opt_state=restored["opt_state"]
+        )
+        self.key = jnp.asarray(restored["key"])
+        self.env_state = restored["env_state"]
+        self.obs = jnp.asarray(restored["obs"])
+        # np.array (owning copy): msgpack_restore hands back read-only
+        # buffers, and this counter is incremented in place per rollout.
+        self.num_timesteps_members = np.array(
+            raw["num_timesteps_members"], np.int64
+        )
+        self.completed_rollouts = int(raw["completed_rollouts"])
+        self._place_on_mesh()
+        self._refresh_active_agents()
+        # Drop metrics rows the resumed run will re-log (the logger
+        # appends; rollouts past the restored checkpoint were recorded
+        # by the interrupted attempt and are about to replay) — the
+        # banked curve must carry each rollout once.
+        mpath = Path(self.log_dir) / "metrics.jsonl"
+        if mpath.exists():
+            import json
+
+            kept = [
+                ln
+                for ln in mpath.read_text().splitlines()
+                if ln.strip()
+                and json.loads(ln).get("step", 0) <= self.num_timesteps
+            ]
+            mpath.write_text("".join(ln + "\n" for ln in kept))
+        print(
+            f"[hetero-sweep] resumed {self.num_seeds}-candidate block "
+            f"from {path} at rollout {self.completed_rollouts}/"
+            f"{self.curriculum.total_rollouts}"
+        )
 
     def _write_summary(self, rewards: np.ndarray) -> None:
         write_sweep_summary(

@@ -409,7 +409,21 @@ stage gnn1024_learn 1800 gnn1024_learn_stage
 # population cost at 64x64-MLP widths is marginal on the MXU.
 export HETERO5_CANDIDATES=8
 hetero5_stage() {
-  rm -rf logs/hetero5_tpu  # append-mode metrics: no cross-retry mixing
+  # RESUME an interrupted block instead of retraining: the K-candidate
+  # curriculum is the longest stage in the queue, and a tunnel drop
+  # mid-train leaves a sweep_state_* population checkpoint behind (the
+  # stage timeout kills the wrapper, so the partial state survives;
+  # HeteroSweepTrainer restores it bit-exactly, incl. mid-stage).
+  # Fresh starts wipe the dir (append-mode metrics: no cross-retry
+  # mixing); an in-window train FAILURE (not a kill) also wipes, so a
+  # corrupt/mismatched state can't wedge every future attempt.
+  local resume_flag=""
+  if ls logs/hetero5_tpu/sweep_state_*.msgpack >/dev/null 2>&1; then
+    resume_flag="resume=true"
+    echo "[hetero5] resuming interrupted candidate block"
+  else
+    rm -rf logs/hetero5_tpu
+  fi
   # Round-5 recipe (VERDICT r4 next-#1, measured on CPU — see
   # docs/acceptance/hetero5/README.md): a 100-rollout fine-tune stage on
   # the final environment (spans a FULL 1000-step episode, so long-horizon
@@ -435,22 +449,26 @@ hetero5_stage() {
   attempt=$(cat docs/acceptance/hetero5/seed_attempt 2>/dev/null || echo 0)
   echo "[hetero5] training candidate block $attempt" \
        "(seeds $((attempt * HETERO5_CANDIDATES))..$(((attempt + 1) * HETERO5_CANDIDATES - 1)))"
-  # save_freq=1000: the default (10 vec-steps = every rollout) would pay
-  # ~200 population device-pulls over the tunnel just for intermediate
-  # checkpoints nobody reads — the final save (+1 midpoint) suffices,
-  # the selection evaluates final checkpoints only.
+  # save_freq=500 (~every 50 rollouts): the default (10 vec-steps =
+  # every rollout) would pay ~200 population device-pulls over the
+  # tunnel, while too-sparse saves cost a dropped window more replayed
+  # rollouts — 500 balances checkpoint overhead (~4 pulls) against the
+  # resume anchor spacing.
   python train.py name=hetero5_tpu num_seeds="$HETERO5_CANDIDATES" \
     seed=$((attempt * HETERO5_CANDIDATES)) num_formation=64 \
     num_agents_per_formation=20 preset=tpu total_timesteps=2560000 \
     ent_coef_final=0.0 log_std_final=-2.5 log_std_decay_start=0.5 \
-    use_wandb=false save_freq=1000 \
+    use_wandb=false save_freq=500 $resume_flag \
     "curriculum=[{rollouts: 30, agent_counts: [5]}, {rollouts: 40, agent_counts: [5, 5, 20]}, {rollouts: 30, agent_counts: [5, 5, 20], num_obstacles: 4}, {rollouts: 100, agent_counts: [5, 5, 20], num_obstacles: 4}]" \
-    || return 1
+    || { rm -rf logs/hetero5_tpu; return 1; }
   # Platform gate only — the stamp means "candidates trained on the
   # chip". Banking (land_tpu_run) is DEFERRED to hetero5_eval's det
   # gate, so a rejected block's curve never overwrites the banked
   # record.
-  python - <<'EOF' || return 1
+  # A platform-gate failure must ALSO wipe: a completed-on-CPU block's
+  # sweep_state would otherwise make every future attempt a no-op
+  # resume (all rollouts done) that re-fails this same gate forever.
+  python - <<'EOF' || { rm -rf logs/hetero5_tpu; return 1; }
 import json
 snap = json.load(open("logs/hetero5_tpu/config.json"))
 got = snap.get("resolved_platform")
@@ -602,6 +620,10 @@ _hetero5_reseed() {
   echo $((attempt + 1)) > docs/acceptance/hetero5/seed_attempt
   echo "[hetero5_eval] candidate block $attempt rejected; rotating"
   rm -f "$STATE/hetero5"
+  # Clear the judged block's run dir: leaving its sweep_state behind
+  # would make the next attempt RESUME the rejected block instead of
+  # training the next seed block.
+  rm -rf logs/hetero5_tpu
 }
 export -f _hetero5_reseed
 export -f hetero5_eval_stage

@@ -157,13 +157,76 @@ def test_checkpoints_and_summary_follow_sweep_contract(tmp_path):
     assert len(summary["final_reward"]) == 2
 
 
-def test_rejections(tmp_path):
-    with pytest.raises(SystemExit, match="resume"):
+def test_resume_bit_exact_mid_stage(tmp_path):
+    """An interrupted candidate block resumed from its sweep_state
+    checkpoint ends bit-identical to an uninterrupted run — including a
+    MID-stage interruption, where the partially-walked stage must NOT be
+    resampled on resume."""
+    env = EnvParams(num_agents=3)
+    # 3 rollouts of stage 1 = the cap lands mid-stage-1 (stage 0 is 2).
+    per_iter_max = PPO.n_steps * 4 * 3  # n_steps * M * N upper bound
+    kw = dict(checkpoint=True, save_freq=10**9)
+
+    full = HeteroSweepTrainer(
+        curriculum=CURR, env_params=env, ppo=PPO, num_seeds=2,
+        config=_cfg(tmp_path, name="full",
+                    log_dir=str(tmp_path / "full"), **kw),
+    )
+    full.train()
+
+    part = HeteroSweepTrainer(
+        curriculum=CURR, env_params=env, ppo=PPO, num_seeds=2,
+        config=_cfg(tmp_path, name="part",
+                    log_dir=str(tmp_path / "part"),
+                    total_timesteps=3 * per_iter_max, **kw),
+    )
+    part.train()  # budget cap stops mid-curriculum; final save() lands
+    assert 0 < part.completed_rollouts < CURR.total_rollouts
+    interrupted_at = part.completed_rollouts
+
+    resumed = HeteroSweepTrainer(
+        curriculum=CURR, env_params=env, ppo=PPO, num_seeds=2,
+        config=_cfg(tmp_path, name="part",
+                    log_dir=str(tmp_path / "part"), resume=True, **kw),
+    )
+    assert resumed.completed_rollouts == interrupted_at
+    resumed.train()
+
+    assert resumed.completed_rollouts == full.completed_rollouts
+    for getter in (
+        lambda t: t.train_state.params,
+        lambda t: t.train_state.opt_state,
+        lambda t: t.key,
+        lambda t: t.env_state,
+        lambda t: t.obs,
+    ):
+        la = jax.tree_util.tree_leaves(getter(resumed))
+        lb = jax.tree_util.tree_leaves(getter(full))
+        assert len(la) == len(lb)
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    np.testing.assert_array_equal(
+        resumed.num_timesteps_members, full.num_timesteps_members
+    )
+
+
+def test_resume_rejects_identity_mismatch(tmp_path):
+    env = EnvParams(num_agents=3)
+    kw = dict(checkpoint=True, save_freq=10**9)
+    t = HeteroSweepTrainer(
+        curriculum=CURR, env_params=env, ppo=PPO, num_seeds=2,
+        config=_cfg(tmp_path, name="a", log_dir=str(tmp_path / "a"), **kw),
+    )
+    t.train()
+    with pytest.raises(SystemExit, match="num_seeds"):
         HeteroSweepTrainer(
-            curriculum=CURR,
-            config=_cfg(tmp_path, resume=True),
-            num_seeds=2,
+            curriculum=CURR, env_params=env, ppo=PPO, num_seeds=1,
+            config=_cfg(tmp_path, name="a", log_dir=str(tmp_path / "a"),
+                        resume=True, **kw),
         )
+
+
+def test_rejections(tmp_path):
     with pytest.raises(SystemExit, match="iters_per_dispatch"):
         HeteroSweepTrainer(
             curriculum=CURR,
