@@ -1,0 +1,35 @@
+"""graftlint: JAX-hygiene static analysis + runtime tracing guards.
+
+The silent killers of a compiled-loop JAX stack are exactly the things no
+functional test catches: accidental retracing, host<->device transfers
+inside the train loop, PRNG key reuse, and version-drifting APIs
+(PAPERS.md: Podracer and JaxMARL both attribute their throughput to
+keeping the whole loop compiled and device-resident). This subpackage
+proves the loop stays that way, permanently, in CI:
+
+- **static** (``linter.py`` + ``rules/``): an AST linter with 8
+  JAX-specific rules run over the whole package by ``tests/
+  test_graftlint.py`` and ``scripts/graftlint.py --check``;
+- **runtime** (``guards.py``): a retrace counter, a device->host
+  transfer guard for the trainer hot loop, and a NaN-guard toggle —
+  surfaced through ``utils.profiling`` and opt-in from
+  ``train.trainer.TrainConfig``.
+
+Rule catalogue, suppression syntax, and guard usage: docs/static_analysis.md.
+"""
+
+from marl_distributedformation_tpu.analysis.config import (  # noqa: F401
+    GraftlintConfig,
+    load_config,
+)
+from marl_distributedformation_tpu.analysis.guards import (  # noqa: F401
+    RetraceError,
+    RetraceGuard,
+    nan_guard,
+    no_host_transfers,
+)
+from marl_distributedformation_tpu.analysis.linter import (  # noqa: F401
+    Violation,
+    lint_paths,
+    lint_source,
+)

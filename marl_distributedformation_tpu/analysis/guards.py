@@ -1,0 +1,132 @@
+"""Runtime tracing guards: the dynamic half of graftlint.
+
+The AST linter (linter.py) sees one file at a time; these guards watch
+the properties that only exist at run time:
+
+- :class:`RetraceGuard` — counts how many times a jit target is actually
+  traced and (optionally) fails the process past a budget. Accidental
+  retracing is the #1 silent throughput killer in JAX: a weak-typed
+  scalar or a drifting static arg recompiles a multi-second XLA program
+  every iteration and nothing crashes.
+- :func:`no_host_transfers` — a ``jax.transfer_guard_device_to_host``
+  context for the trainer hot loop: any ``.item()`` / ``float()`` /
+  implicit ``__array__`` sync inside the guarded region raises instead
+  of silently serializing the dispatch pipeline (on a tunneled TPU each
+  sync pays a full RTT).
+- :func:`nan_guard` — scoped ``jax_debug_nans`` toggle: XLA re-runs any
+  op that produced a NaN in op-by-op mode and raises at the source op.
+
+All three are re-exported through ``utils.profiling`` and opt-in from
+``train.trainer.TrainConfig`` (``guard_retraces`` / ``guard_transfers``
+/ ``guard_nans``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+
+
+class RetraceError(RuntimeError):
+    """A guarded jit target compiled more often than its budget allows."""
+
+
+class RetraceGuard:
+    """Count (and optionally bound) the traces of a jit target.
+
+    Wrap the Python callable BEFORE handing it to ``jax.jit``: the
+    wrapper body runs exactly once per trace (jit executes the Python
+    function only on cache miss), so ``count`` equals the number of
+    compilations this process triggered for it.
+
+    >>> guard = RetraceGuard("train_iteration", max_traces=2)
+    >>> step = jax.jit(guard.wrap(step_fn), donate_argnums=(0,))
+
+    ``max_traces=None`` only counts. With a budget, the trace that
+    exceeds it raises :class:`RetraceError` naming the argument
+    signature that caused it — at the retrace, where the stack still
+    shows which caller changed shapes/dtypes.
+    """
+
+    def __init__(
+        self, name: str = "jit-target", max_traces: Optional[int] = None
+    ) -> None:
+        self.name = name
+        self.max_traces = max_traces
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def reset(self) -> None:
+        with self._lock:
+            self.count = 0
+
+    def _describe(self, args: Any, kwargs: Any) -> str:
+        def leaf(x: Any) -> str:
+            shape = getattr(x, "shape", None)
+            dtype = getattr(x, "dtype", None)
+            if shape is None or dtype is None:
+                return f"{type(x).__name__}:{x!r}"[:40]
+            return f"{dtype}{list(shape)}"
+
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        head = ", ".join(leaf(x) for x in leaves[:8])
+        extra = len(leaves) - 8
+        return head + (f", … +{extra} leaves" if extra > 0 else "")
+
+    def wrap(self, fn: Callable[..., Any]) -> Callable[..., Any]:
+        @functools.wraps(fn)
+        def traced(*args: Any, **kwargs: Any) -> Any:
+            with self._lock:
+                self.count += 1
+                count = self.count
+            if self.max_traces is not None and count > self.max_traces:
+                raise RetraceError(
+                    f"{self.name!r} traced {count} times "
+                    f"(budget {self.max_traces}) — a shape, dtype, "
+                    "weak-type, or static-arg drift is forcing "
+                    "recompilation every call; offending signature: "
+                    f"[{self._describe(args, kwargs)}]"
+                )
+            return fn(*args, **kwargs)
+
+        return traced
+
+
+@contextlib.contextmanager
+def no_host_transfers(level: str = "disallow") -> Iterator[None]:
+    """Forbid device->host transfers in the wrapped region.
+
+    Device-to-host only: host-to-device constant uploads during
+    compilation are part of tracing and stay allowed — the hot-loop
+    poison is the reverse direction (``.item()``, ``float()``, implicit
+    ``np.asarray``), which serializes the dispatch pipeline behind a
+    sync. ``level`` follows ``jax.transfer_guard``: ``"disallow"``
+    raises, ``"log"`` prints and continues (triage mode).
+
+    Backend caveat: the XLA CPU backend aliases device and host memory,
+    so readbacks there are zero-copy and the guard never fires — it is a
+    no-op on CPU and enforceable on TPU/GPU. The static complement
+    (graftlint's host-sync-in-jit rule) catches spelled-out syncs on
+    every backend; this guard catches the implicit ones on hardware,
+    which is where they cost real RTTs.
+    """
+    with jax.transfer_guard_device_to_host(level):
+        yield
+
+
+@contextlib.contextmanager
+def nan_guard(enable: bool = True) -> Iterator[None]:
+    """Scoped ``jax_debug_nans``: ops that produce NaN re-run op-by-op
+    and raise at the source op instead of poisoning the whole rollout.
+    Restores the previous setting on exit (compose freely with training
+    code that toggles it)."""
+    previous = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", previous)

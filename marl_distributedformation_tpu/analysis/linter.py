@@ -1,0 +1,446 @@
+"""AST linter engine: traced-scope discovery, taint tracking, suppression.
+
+The rules (``analysis/rules/``) are small because this module answers the
+two questions every JAX-hygiene check needs:
+
+1. **Which functions are traced?** Anything decorated with / passed to a
+   tracing entry point (``jit``, ``shard_map``, ``vmap``, ``pmap``,
+   ``lax.scan`` / ``while_loop`` / ``cond`` / ``fori_loop`` / ``map``),
+   plus every function *nested inside* one (closures trace with their
+   parent). Cross-module tracing (a function returned here and jitted
+   elsewhere) is invisible to a per-file AST pass — the linter covers the
+   jit boundary layer and the runtime guards (guards.py) cover the rest.
+2. **Which names hold traced values?** Parameters of traced scopes, plus
+   anything assigned from an expression that mentions a tainted name —
+   EXCEPT static extractors (``x.shape``, ``x.ndim``, ``x.dtype``,
+   ``len(x)``, ``isinstance(...)``, ``x is None``), which produce
+   trace-time Python values and must not poison downstream checks.
+
+Suppression: ``# graftlint: disable=<rule>[,<rule>...]`` as a trailing
+comment on the flagged line or as a comment-only line directly above it;
+``# graftlint: disable-file=<rule>`` anywhere disables a rule for the
+whole file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from marl_distributedformation_tpu.analysis.config import GraftlintConfig
+
+# Attribute / builtin accesses that yield static (non-traced) Python values
+# even when applied to a traced array.
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "aval", "weak_type"})
+# Parameter names that conventionally carry static config objects in this
+# codebase (EnvParams / PPOConfig / TrainConfig dataclasses, meshes), not
+# traced arrays — tuned so `if params.strict_parity:` style trace-time
+# branching stays clean. NN parameters are spelled `nn_params` /
+# `train_state.params` here, so `params` is unambiguous. A tuned list is
+# the standard lint trade-off; adjust here if the convention changes.
+STATIC_PARAM_NAMES = frozenset(
+    {"self", "cls", "params", "config", "cfg", "ppo", "env_params",
+     "hparams", "mesh", "train_config"}
+)
+STATIC_CALLS = frozenset(
+    {"len", "isinstance", "issubclass", "getattr", "hasattr", "type", "id",
+     "callable", "repr", "str"}
+)
+
+# Tracing entry points -> positions of the traced callables among the
+# positional args. Decorator usage is handled separately.
+TRACING_ENTRY_ARGS: Dict[str, Tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jit": (0,),
+    "jax.shard_map": (0,),
+    "shard_map": (0,),
+    "jax.vmap": (0,),
+    "vmap": (0,),
+    "jax.pmap": (0,),
+    "pmap": (0,),
+    "jax.lax.scan": (0,),
+    "lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "lax.map": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2),
+    "lax.cond": (1, 2),
+    "jax.lax.fori_loop": (2,),
+    "lax.fori_loop": (2,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+}
+
+JIT_NAMES = frozenset({"jax.jit", "jit"})
+PARTIAL_NAMES = frozenset({"functools.partial", "partial"})
+
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable\s*=\s*([\w\-,\s]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file\s*=\s*([\w\-,\s]+)")
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _split_rule_list(raw: str) -> Set[str]:
+    """Leading REGISTERED rule names from a suppression payload. The
+    payload ends at the first token that is not a known rule, so trailing
+    prose can mention other rules by name without suppressing them
+    (``disable=numpy-in-jit unlike host-sync-in-jit this is safe``
+    suppresses only numpy-in-jit)."""
+    from marl_distributedformation_tpu.analysis.rules import rule_names
+
+    known = set(rule_names())
+    names: Set[str] = set()
+    for token in re.split(r"[\s,]+", raw.strip()):
+        if token in known:
+            names.add(token)
+        else:
+            break
+    return names
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str  # "error" | "warn"
+
+    def __str__(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.severity.upper()} [{self.rule}] {self.message}"
+        )
+
+
+class Rule:
+    """Base class for graftlint rules. Subclasses set ``name``,
+    ``default_severity``, ``description`` and implement :meth:`check`."""
+
+    name: str = "abstract"
+    default_severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterator[Tuple[int, int, str]]:
+        raise NotImplementedError
+
+
+FunctionLike = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class ModuleContext:
+    """One parsed module plus the traced-scope / taint analyses rules
+    share. Built once per file; rules only read from it."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str) -> None:
+        self.tree = tree
+        self.path = path
+        self.lines = source.splitlines()
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+        self.traced_scopes: Set[ast.AST] = self._find_traced_scopes()
+        self.traced_roots: List[ast.AST] = [
+            scope
+            for scope in self.traced_scopes
+            if not self._has_traced_ancestor(scope)
+        ]
+        self.traced_roots.sort(key=lambda n: (n.lineno, n.col_offset))
+        self._taint_cache: Dict[ast.AST, Set[str]] = {}
+        self.file_disabled: Set[str] = set()
+        for line in self.lines:
+            m = _DISABLE_FILE_RE.search(line)
+            if m:
+                self.file_disabled |= _split_rule_list(m.group(1))
+
+    # -- traced-scope discovery ----------------------------------------
+
+    def _is_jit_like(self, node: ast.AST) -> bool:
+        """True for an expression denoting a tracing transform: ``jax.jit``,
+        ``shard_map``, ``functools.partial(jax.jit, ...)``, or a call of
+        any of those (``jax.jit(static_argnums=...)`` decorator style)."""
+        name = dotted_name(node)
+        if name in TRACING_ENTRY_ARGS:
+            return True
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in TRACING_ENTRY_ARGS:
+                return True
+            if fname in PARTIAL_NAMES and node.args:
+                return self._is_jit_like(node.args[0])
+        return False
+
+    def _resolve_callable(self, node: ast.AST) -> List[ast.AST]:
+        if isinstance(node, ast.Lambda):
+            return [node]
+        if isinstance(node, ast.Name):
+            return list(self._defs_by_name.get(node.id, ()))
+        if isinstance(node, ast.Call):
+            # peel wrapping transforms: jax.jit(jax.vmap(f)), partial(f, ...)
+            fname = dotted_name(node.func)
+            if fname in TRACING_ENTRY_ARGS or fname in PARTIAL_NAMES:
+                return [
+                    t for arg in node.args for t in self._resolve_callable(arg)
+                ]
+        return []
+
+    def _find_traced_scopes(self) -> Set[ast.AST]:
+        traced: Set[ast.AST] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._is_jit_like(d) for d in node.decorator_list):
+                    traced.add(node)
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                positions = TRACING_ENTRY_ARGS.get(fname or "")
+                if positions is None:
+                    continue
+                for pos in positions:
+                    if pos < len(node.args):
+                        traced.update(self._resolve_callable(node.args[pos]))
+        # Closure rule: every function nested in a traced scope traces
+        # with it.
+        out = set(traced)
+        for node in ast.walk(self.tree):
+            if isinstance(node, FunctionLike) and any(
+                anc in traced for anc in self._ancestors(node)
+            ):
+                out.add(node)
+        return out
+
+    def _ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def _has_traced_ancestor(self, node: ast.AST) -> bool:
+        return any(a in self.traced_scopes for a in self._ancestors(node))
+
+    def enclosing_traced_scope(self, node: ast.AST) -> Optional[ast.AST]:
+        if node in self.traced_scopes:
+            return node
+        for anc in self._ancestors(node):
+            if anc in self.traced_scopes:
+                return anc
+        return None
+
+    # -- taint ----------------------------------------------------------
+
+    @staticmethod
+    def _param_names(scope: ast.AST) -> Set[str]:
+        """Parameters presumed to carry traced values: everything except
+        config-named params (STATIC_PARAM_NAMES) and flag-like params
+        whose default is a literal constant (``with_obs=True``,
+        ``block_r=1024`` — static mode switches / tile sizes, which under
+        jit are static_argnums or closure constants)."""
+        args = scope.args
+        positional = [*args.posonlyargs, *args.args]
+        static: Set[str] = set(STATIC_PARAM_NAMES)
+        for arg, default in zip(
+            reversed(positional), reversed(args.defaults)
+        ):
+            if isinstance(default, ast.Constant):
+                static.add(arg.arg)
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None and isinstance(default, ast.Constant):
+                static.add(arg.arg)
+        names = {
+            a.arg
+            for a in (
+                *positional, *args.kwonlyargs,
+                *filter(None, (args.vararg, args.kwarg)),
+            )
+        }
+        return names - static
+
+    def taint_for(self, root: ast.AST) -> Set[str]:
+        """Names holding (potentially) traced values anywhere inside the
+        traced root: its parameters, parameters of nested functions, and
+        fixpoint propagation through assignments."""
+        cached = self._taint_cache.get(root)
+        if cached is not None:
+            return cached
+        taint: Set[str] = set()
+        for node in ast.walk(root):
+            if isinstance(node, FunctionLike):
+                taint |= self._param_names(node)
+        if isinstance(root, FunctionLike):
+            taint |= self._param_names(root)
+        for _ in range(4):  # fixpoint; chains deeper than 4 hops are rare
+            grew = False
+            for node in ast.walk(root):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.For):
+                    targets, value = [node.target], node.iter
+                if value is None or not self.expr_tainted(value, taint):
+                    continue
+                for name in self._target_names(targets):
+                    if name not in taint:
+                        taint.add(name)
+                        grew = True
+            if not grew:
+                break
+        self._taint_cache[root] = taint
+        return taint
+
+    @staticmethod
+    def _target_names(targets: Iterable[ast.AST]) -> Iterator[str]:
+        for t in targets:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name):
+                    yield node.id
+
+    def expr_tainted(self, node: ast.AST, taint: Set[str]) -> bool:
+        """Does evaluating ``node`` touch a traced value? Static
+        extractors (shape/dtype/len/isinstance/is-None) break the chain."""
+        if isinstance(node, ast.Name):
+            return node.id in taint
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value, taint)
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in STATIC_CALLS:
+                return False
+            return any(
+                self.expr_tainted(c, taint)
+                for c in ast.iter_child_nodes(node)
+            )
+        if isinstance(node, ast.Compare):
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                return False  # `x is None`: structural, never traced
+            if any(
+                isinstance(c, ast.Constant) and isinstance(c.value, str)
+                for c in (node.left, *node.comparators)
+            ):
+                return False  # comparing to a string: trace-time metadata
+        return any(
+            self.expr_tainted(c, taint) for c in ast.iter_child_nodes(node)
+        )
+
+    # -- suppression -----------------------------------------------------
+
+    def suppressed(self, line: int, rule_name: str) -> bool:
+        if rule_name in self.file_disabled:
+            return True
+        candidates = []
+        if 1 <= line <= len(self.lines):
+            candidates.append(self.lines[line - 1])
+        if 2 <= line <= len(self.lines) + 1:
+            above = self.lines[line - 2]
+            if above.lstrip().startswith("#"):
+                candidates.append(above)
+        for text in candidates:
+            m = _DISABLE_RE.search(text)
+            if m and rule_name in _split_rule_list(m.group(1)):
+                return True
+        return False
+
+
+# ----------------------------------------------------------------------
+# Entry points
+# ----------------------------------------------------------------------
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    config: Optional[GraftlintConfig] = None,
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Violation]:
+    """Lint one module's source; returns violations sorted by location.
+    Rules configured ``off`` are skipped; per-line / per-file suppression
+    comments are honored."""
+    from marl_distributedformation_tpu.analysis.rules import all_rules
+
+    config = config or GraftlintConfig()
+    active = list(rules) if rules is not None else all_rules()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [
+            Violation(
+                "syntax-error", path, e.lineno or 0, e.offset or 0,
+                f"file does not parse: {e.msg}", "error",
+            )
+        ]
+    ctx = ModuleContext(tree, source, path)
+    violations: List[Violation] = []
+    for rule in active:
+        severity = config.rule_severity(rule.name, rule.default_severity)
+        if severity == "off":
+            continue
+        for line, col, message in rule.check(ctx):
+            if ctx.suppressed(line, rule.name):
+                continue
+            violations.append(
+                Violation(rule.name, path, line, col, message, severity)
+            )
+    return sorted(violations, key=lambda v: (v.path, v.line, v.col, v.rule))
+
+
+def iter_python_files(
+    paths: Sequence, config: GraftlintConfig, root: Optional[Path] = None
+) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not config.excludes_path(f, root):
+                    yield f
+        elif p.suffix == ".py" and not config.excludes_path(p, root):
+            yield p
+
+
+def lint_paths(
+    paths: Sequence,
+    config: Optional[GraftlintConfig] = None,
+    rules: Optional[Sequence[Rule]] = None,
+    root: Optional[Path] = None,
+) -> List[Violation]:
+    """Lint every ``.py`` file under ``paths`` (files or directories),
+    honoring the config's exclude list."""
+    config = config or GraftlintConfig()
+    violations: List[Violation] = []
+    for f in iter_python_files(paths, config, root):
+        violations.extend(
+            lint_source(
+                f.read_text(encoding="utf-8"), str(f), config, rules
+            )
+        )
+    return violations

@@ -1,0 +1,38 @@
+"""graftlint rule registry. Rules are stateless between files (any
+per-check state is reset inside ``check``), so one shared instance per
+rule serves every lint run."""
+
+from typing import List
+
+from marl_distributedformation_tpu.analysis.linter import Rule
+from marl_distributedformation_tpu.analysis.rules.capture import (
+    MutableCaptureInJit,
+)
+from marl_distributedformation_tpu.analysis.rules.control_flow import (
+    TracedPythonControlFlow,
+)
+from marl_distributedformation_tpu.analysis.rules.deprecated import DeprecatedApi
+from marl_distributedformation_tpu.analysis.rules.donation import MissingDonate
+from marl_distributedformation_tpu.analysis.rules.host_sync import HostSyncInJit
+from marl_distributedformation_tpu.analysis.rules.numpy_use import NumpyInJit
+from marl_distributedformation_tpu.analysis.rules.printing import PrintInJit
+from marl_distributedformation_tpu.analysis.rules.prng import PrngKeyReuse
+
+RULES = (
+    NumpyInJit(),
+    TracedPythonControlFlow(),
+    PrngKeyReuse(),
+    HostSyncInJit(),
+    MutableCaptureInJit(),
+    DeprecatedApi(),
+    MissingDonate(),
+    PrintInJit(),
+)
+
+
+def all_rules() -> List[Rule]:
+    return list(RULES)
+
+
+def rule_names() -> List[str]:
+    return [r.name for r in RULES]

@@ -1,0 +1,68 @@
+"""mutable-capture-in-jit: trace-time mutable state in jitted closures.
+
+A mutable default argument (``def step(x, buf=[])``) or a ``global``
+write inside a jitted function executes at *trace* time, not run time:
+the side effect happens once per compile (silently skipped on cache
+hits, repeated on retraces) and never per step — the classic "my counter
+only advanced twice" bug. Flag both; trace-time reads of module globals
+(constants, config) are idiomatic and stay allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import ModuleContext, Rule
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+_MUTABLE_CTORS = frozenset({"list", "dict", "set", "bytearray", "collections.deque", "deque"})
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call):
+        from marl_distributedformation_tpu.analysis.linter import dotted_name
+
+        return dotted_name(node.func) in _MUTABLE_CTORS
+    return False
+
+
+class MutableCaptureInJit(Rule):
+    name = "mutable-capture-in-jit"
+    default_severity = "error"
+    description = (
+        "mutable default argument or global/nonlocal write in a jitted "
+        "function — the side effect runs at trace time, not per step"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for root in ctx.traced_roots:
+            for node in ast.walk(root):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defaults = [
+                        *node.args.defaults, *node.args.kw_defaults,
+                    ]
+                    for d in defaults:
+                        if d is not None and _is_mutable_default(d):
+                            yield (
+                                d.lineno,
+                                d.col_offset,
+                                f"mutable default argument on jitted "
+                                f"function {node.name!r} — shared across "
+                                "every trace; pass it explicitly",
+                            )
+                elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                    names = ", ".join(node.names)
+                    kind = (
+                        "global" if isinstance(node, ast.Global) else "nonlocal"
+                    )
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        f"`{kind} {names}` write inside a jitted function "
+                        "runs at trace time only (once per compile, never "
+                        "per step) — thread state through the function "
+                        "instead",
+                    )

@@ -1,0 +1,51 @@
+"""traced-python-control-flow: ``if``/``while`` on traced values.
+
+Python control flow evaluates its condition at *trace* time: on a traced
+value it either raises a ConcretizationTypeError (under jit) or — the
+silent version — bakes one branch into the compiled program and triggers
+a retrace whenever the concrete value flips. The fix is ``jnp.where`` /
+``lax.cond`` / ``lax.while_loop``. Static predicates (``x is None``,
+``x.shape[0] > 2``, ``isinstance(...)``, closure config flags) are
+trace-time Python and stay allowed — see the taint rules in
+``linter.ModuleContext``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import ModuleContext, Rule
+
+_FIX = {
+    ast.If: "jnp.where or lax.cond",
+    ast.IfExp: "jnp.where or lax.cond",
+    ast.While: "lax.while_loop or lax.fori_loop",
+}
+
+
+class TracedPythonControlFlow(Rule):
+    name = "traced-python-control-flow"
+    default_severity = "error"
+    description = (
+        "Python if/while on a traced value inside a jitted function — "
+        "concretizes at trace time or silently specializes the program"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for root in ctx.traced_roots:
+            taint = ctx.taint_for(root)
+            for node in ast.walk(root):
+                if not isinstance(node, (ast.If, ast.IfExp, ast.While)):
+                    continue
+                if ctx.expr_tainted(node.test, taint):
+                    kind = (
+                        "while" if isinstance(node, ast.While) else "if"
+                    )
+                    yield (
+                        node.test.lineno,
+                        node.test.col_offset,
+                        f"Python `{kind}` on a traced value — use "
+                        f"{_FIX[type(node)]} so the branch stays inside "
+                        "the compiled program",
+                    )

@@ -1,0 +1,80 @@
+"""host-sync-in-jit: device->host synchronization inside traced code.
+
+``.item()`` / ``.tolist()`` / ``float()`` / ``int()`` / ``bool()`` /
+``np.asarray()`` / ``jax.device_get()`` on a traced value force a
+round-trip to the host: under ``jit`` they raise a concretization error;
+in the eager fragments around a hot loop they serialize every dispatch
+behind a transfer (the failure mode Podracer's anakin architecture
+exists to avoid). The runtime complement is
+``analysis.guards.no_host_transfers``, which catches the spellings the
+AST cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+_SYNC_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+_SYNC_METHODS = frozenset({"item", "tolist", "__array__"})
+# The numpy spellings this rule owns; numpy-in-jit imports this set to
+# stay out of the way (one defect must yield one report).
+NUMPY_SYNC_SPELLINGS = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+)
+_SYNC_CALLS = NUMPY_SYNC_SPELLINGS | frozenset(
+    {"jax.device_get", "device_get"}
+)
+
+
+class HostSyncInJit(Rule):
+    name = "host-sync-in-jit"
+    default_severity = "error"
+    description = (
+        "device->host sync (.item()/float()/np.asarray()/device_get) "
+        "inside a jitted function"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for root in ctx.traced_roots:
+            taint = ctx.taint_for(root)
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._spelling(ctx, node, taint)
+                if hit:
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        f"{hit} forces a device->host sync inside a jitted "
+                        "function (concretization error under jit; a "
+                        "serializing transfer in eager hot loops)",
+                    )
+
+    @staticmethod
+    def _spelling(ctx: ModuleContext, node: ast.Call, taint) -> str:
+        fname = dotted_name(node.func)
+        if fname in _SYNC_CALLS and any(
+            ctx.expr_tainted(a, taint) for a in node.args
+        ):
+            return f"{fname}(...)"
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _SYNC_BUILTINS
+            and node.args
+            and any(ctx.expr_tainted(a, taint) for a in node.args)
+        ):
+            return f"{node.func.id}(...)"
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_METHODS
+            and ctx.expr_tainted(node.func.value, taint)
+        ):
+            return f".{node.func.attr}()"
+        return ""

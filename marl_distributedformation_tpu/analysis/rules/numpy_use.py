@@ -1,0 +1,55 @@
+"""numpy-in-jit: host numpy applied to traced values inside traced code.
+
+``np.*`` on static Python values inside a jitted function is fine (it
+folds into a trace-time constant — the idiomatic way to precompute
+tables). ``np.*`` on a *traced* value is a silent catastrophe: it forces
+the tracer to concretize, which either raises TracerArrayConversionError
+or — worse, via implicit __array__ on committed arrays in eager helpers —
+synchronizes device to host every step. Flag numpy calls whose arguments
+touch tainted names; the pure host-sync spellings (``np.asarray`` /
+``np.array``) are owned by the host-sync-in-jit rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+from marl_distributedformation_tpu.analysis.rules.host_sync import (
+    NUMPY_SYNC_SPELLINGS,
+)
+
+
+class NumpyInJit(Rule):
+    name = "numpy-in-jit"
+    default_severity = "error"
+    description = (
+        "host numpy called on a traced value inside a jitted function — "
+        "concretizes the tracer (error) or silently syncs the device; "
+        "use jax.numpy"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for root in ctx.traced_roots:
+            taint = ctx.taint_for(root)
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                if not fname or not fname.split(".", 1)[0] in ("np", "numpy"):
+                    continue
+                if fname in ("np", "numpy") or fname in NUMPY_SYNC_SPELLINGS:
+                    continue
+                args = [*node.args, *(k.value for k in node.keywords)]
+                if any(ctx.expr_tainted(a, taint) for a in args):
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        f"{fname}(...) applied to a traced value inside a "
+                        "jitted function — use the jax.numpy equivalent",
+                    )

@@ -1,0 +1,69 @@
+"""print-in-jit: host printing / tracer interpolation inside traced code.
+
+``print`` inside a jitted function runs at trace time only — it shows
+the TRACER once per compile, never the runtime values, and its absence
+on later calls is routinely misread as "the code stopped running".
+Interpolating a traced value into an f-string is the same bug in string
+clothing: the formatted text bakes in ``Traced<ShapedArray(...)>``.
+``jax.debug.print`` is the supported spelling for both. F-strings over
+static values (shapes in error messages) are idiomatic and stay allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import ModuleContext, Rule
+
+
+class PrintInJit(Rule):
+    name = "print-in-jit"
+    default_severity = "error"
+    description = (
+        "print / f-string on traced values inside a jitted function — "
+        "runs at trace time with tracer reprs; use jax.debug.print"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for root in ctx.traced_roots:
+            taint = ctx.taint_for(root)
+            for node in ast.walk(root):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                ):
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        "print() inside a jitted function runs at trace "
+                        "time only — use jax.debug.print for runtime "
+                        "values (or drop it)",
+                    )
+                elif (
+                    isinstance(node, ast.JoinedStr)
+                    and not self._in_failure_path(ctx, node)
+                    and any(
+                        isinstance(v, ast.FormattedValue)
+                        and ctx.expr_tainted(v.value, taint)
+                        for v in node.values
+                    )
+                ):
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        "f-string interpolates a traced value — the text "
+                        "bakes in the tracer repr; use jax.debug.print "
+                        "formatting instead",
+                    )
+
+    @staticmethod
+    def _in_failure_path(ctx: ModuleContext, node: ast.AST) -> bool:
+        """F-strings in ``assert`` / ``raise`` messages only evaluate on
+        the trace-time failure path — a tracer repr there is a debugging
+        aid, not a landmine."""
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = ctx.parents.get(cur)
+        return isinstance(cur, (ast.Assert, ast.Raise))

@@ -1,0 +1,187 @@
+"""prng-key-reuse: the same PRNG key consumed by two ``jax.random`` calls.
+
+JAX keys are splittable, not advancing: feeding one key to two sampling
+calls yields *correlated* (often identical) draws — in PPO that silently
+couples action noise across rollout steps, which trains but converges to
+the wrong policy. The rule tracks, per function scope and in execution
+order, names passed as the key argument to consuming ``jax.random``
+functions; a second consumption without an intervening rebind is
+flagged. ``fold_in`` (designed for repeated use with varying data) and
+key constructors are exempt; uses on disjoint ``if``/``else`` branches
+are merged, and a consumption inside a loop body whose key is never
+rebound in the body is flagged (every iteration reuses it).
+
+Scope note: detection is alias-based (the spelled name), so it is
+per-scope and conservative — keys smuggled through containers or
+attributes are invisible. That is the usual lint trade-off: the rule
+catches the way the bug is actually written.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    FunctionLike,
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+# jax.random functions that CONSUME their key argument (reuse after any of
+# these is the bug). fold_in / PRNGKey / key / clone / key_data are not
+# consumers.
+_CONSUMING = frozenset(
+    {
+        "split", "uniform", "normal", "bernoulli", "categorical", "gumbel",
+        "choice", "permutation", "shuffle", "randint", "truncated_normal",
+        "laplace", "exponential", "beta", "gamma", "poisson", "dirichlet",
+        "multivariate_normal", "cauchy", "rademacher", "maxwell", "weibull_min",
+        "double_sided_maxwell", "orthogonal", "t", "loggamma", "binomial",
+        "bits", "ball", "logistic", "pareto", "rayleigh", "triangular",
+        "wald", "geometric", "generalized_normal",
+    }
+)
+
+
+def _random_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to the ``jax.random`` module (``from jax import
+    random``, ``import jax.random as jr`` …)."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "random":
+                    aliases.add(a.asname or "random")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    aliases.add(a.asname)
+    return aliases
+
+
+class _ScopeState:
+    """Names whose key has been consumed and not yet rebound, mapped to
+    the consuming call node (for the report)."""
+
+    def __init__(self) -> None:
+        self.armed: Dict[str, ast.Call] = {}
+
+    def copy(self) -> "_ScopeState":
+        s = _ScopeState()
+        s.armed = dict(self.armed)
+        return s
+
+
+class PrngKeyReuse(Rule):
+    name = "prng-key-reuse"
+    default_severity = "error"
+    description = (
+        "a PRNG key passed to two consuming jax.random calls — draws "
+        "become correlated; split the key"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        self._aliases = _random_aliases(ctx.tree) | {"jax.random"}
+        scopes: List[ast.AST] = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree) if isinstance(n, FunctionLike)
+        ]
+        for scope in scopes:
+            self._violations = []
+            self._seen: Set[Tuple[int, int]] = set()
+            state = _ScopeState()
+            # Lambda bodies are a single expression, not a statement list
+            # — and they are where scan/while_loop step functions (the
+            # natural home of per-step keys) live.
+            body = scope.body if isinstance(scope.body, list) else [scope.body]
+            for stmt in body:
+                self._visit(stmt, state)
+            yield from self._violations
+
+    # -- ordered walk ----------------------------------------------------
+
+    def _key_name(self, call: ast.Call) -> Optional[str]:
+        fname = dotted_name(call.func) or ""
+        head, _, fn = fname.rpartition(".")
+        if fn not in _CONSUMING or head not in self._aliases:
+            return None
+        key_arg: Optional[ast.AST] = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "key":
+                key_arg = kw.value
+        return key_arg.id if isinstance(key_arg, ast.Name) else None
+
+    def _consume(self, call: ast.Call, state: _ScopeState) -> None:
+        name = self._key_name(call)
+        if name is None:
+            return
+        prior = state.armed.get(name)
+        pos = (call.lineno, call.col_offset)
+        if prior is not None and pos not in self._seen:
+            self._seen.add(pos)
+            self._violations.append(
+                (
+                    *pos,
+                    f"key {name!r} already consumed by the jax.random call "
+                    f"on line {prior.lineno} — split it instead of reusing",
+                )
+            )
+        state.armed[name] = call
+
+    def _bind(self, target: ast.AST, state: _ScopeState) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                state.armed.pop(node.id, None)
+
+    def _visit(self, node: ast.AST, state: _ScopeState) -> None:
+        if isinstance(node, FunctionLike):
+            return  # separate scope (closures run at their own cadence)
+        if isinstance(node, ast.Assign):
+            self._visit(node.value, state)
+            for t in node.targets:
+                self._bind(t, state)
+            return
+        if isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if node.value is not None:
+                self._visit(node.value, state)
+            self._bind(node.target, state)
+            return
+        if isinstance(node, ast.NamedExpr):
+            self._visit(node.value, state)
+            self._bind(node.target, state)
+            return
+        if isinstance(node, ast.If):
+            self._visit(node.test, state)
+            a = state.copy()
+            for s in node.body:
+                self._visit(s, a)
+            b = state.copy()
+            for s in node.orelse:
+                self._visit(s, b)
+            state.armed = {**a.armed, **b.armed}
+            return
+        if isinstance(node, (ast.For, ast.While)):
+            if isinstance(node, ast.For):
+                self._visit(node.iter, state)
+                self._bind(node.target, state)
+            else:
+                self._visit(node.test, state)
+            # Two symbolic iterations: the second starts from the first's
+            # end state, so a key consumed in the body and not rebound
+            # before its next consumption flags exactly like straight-line
+            # reuse. Violations dedupe by position, so intra-body reuses
+            # (already reported on pass one) are not double-counted.
+            body_state = state.copy()
+            for s in node.body:
+                self._visit(s, body_state)
+            for s in node.body:
+                self._visit(s, body_state)
+            state.armed = body_state.armed
+            for s in node.orelse:
+                self._visit(s, state)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, state)
+        if isinstance(node, ast.Call):
+            self._consume(node, state)

@@ -1,0 +1,107 @@
+"""Version-portability shims for drifting JAX APIs.
+
+JAX moved ``shard_map`` from ``jax.experimental.shard_map.shard_map``
+(<= 0.4.x) to ``jax.shard_map`` (>= 0.6), and renamed its replication
+checker from ``check_rep`` to ``check_vma`` in the same move. Every
+``shard_map`` call site in this package routes through :func:`shard_map`
+below so the package runs unmodified on either side of the drift; the
+``graftlint`` ``deprecated-api`` rule (analysis/rules/deprecated.py)
+enforces that no new direct spelling sneaks back in.
+
+Also home to :func:`manual_axis_context`, the trace-context probe that
+``ops.knn._spmd_partitioner_controlled`` uses on pre-sharding-in-types
+JAX (where tracer avals carry no sharding): inside ``shard_map`` the mesh
+axes are bound as named axis frames, under plain ``jit`` they are not —
+the same boundary the newer aval-mesh ``axis_types`` probe detects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def _ensure_sharding_invariant_prng() -> None:
+    """Normalize the PRNG to modern-JAX semantics: sharding-invariant.
+
+    jax <= 0.4.x defaults ``jax_threefry_partitionable`` to False, where
+    a ``jax.random`` draw lowered under the SPMD partitioner (sharded
+    operands in the surrounding program) produces DIFFERENT bits than
+    the identical unsharded program — measured here as a 6% reward
+    divergence between dp×sp-sharded and single-device training with
+    identical seeds, silently breaking the repo's sharded == unsharded
+    trajectory invariant (tests/test_parallel.py). Newer JAX made
+    partitionable threefry the default and removed the flag; force it on
+    wherever the flag still exists so every JAX version draws the same,
+    placement-independent streams.
+    """
+    try:
+        if not jax.config.jax_threefry_partitionable:
+            jax.config.update("jax_threefry_partitionable", True)
+    except AttributeError:
+        pass  # new jax: partitionable is the only implementation
+
+
+_ensure_sharding_invariant_prng()
+
+
+def resolve_shard_map() -> tuple[Callable[..., Any], bool]:
+    """The installed JAX's shard_map and whether it is the NEW spelling:
+    ``(jax.shard_map, True)`` when present, else
+    ``(jax.experimental.shard_map.shard_map, False)``. Resolved at call
+    time (not import time) so tests can monkeypatch either spelling."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, True
+    # graftlint: disable=deprecated-api — this IS the shim the rule points to
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy, False
+
+
+def shard_map(
+    f: Callable[..., Any],
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: Optional[bool] = None,
+) -> Callable[..., Any]:
+    """``shard_map`` across JAX versions (keyword-only, new-API surface).
+
+    ``check_vma`` maps onto the installed API's replication-checker flag:
+    passed through verbatim on new JAX, translated to ``check_rep`` on
+    old JAX; ``None`` leaves the installed default in place.
+    """
+    impl, is_new = resolve_shard_map()
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check_vma is not None:
+        kwargs["check_vma" if is_new else "check_rep"] = check_vma
+    return impl(f, **kwargs)
+
+
+def manual_axis_context() -> bool:
+    """True when the caller is tracing inside a manual-axes region
+    (``shard_map`` / ``pmap``) on pre-sharding-in-types JAX, where the
+    mesh axes are bound as named axis frames. False under plain ``jit``
+    or eager execution, and on JAX versions that removed the axis-env
+    accessor (those carry sharding on tracer avals instead — see
+    ``ops.knn._spmd_partitioner_controlled``)."""
+    for probe in (
+        lambda: jax.core.get_axis_env().axis_sizes,
+        # jax.core re-exports get_axis_env on some 0.4.x releases only;
+        # the _src accessor covers most of the legacy range, and the
+        # thread-local axis frames the releases before get_axis_env.
+        lambda: jax._src.core.get_axis_env().axis_sizes,
+        lambda: {
+            f.name: f.size
+            for f in jax.core.thread_local_state.trace_state.axis_env
+        },
+    ):
+        try:
+            sizes = probe()
+        except Exception:
+            continue
+        return bool(sizes)
+    return False

@@ -31,6 +31,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from marl_distributedformation_tpu.jax_compat import manual_axis_context
+
 Array = jax.Array
 
 # Self-distance mask. Finite (not inf) so top_k never selects NaN garbage
@@ -156,23 +158,39 @@ def _spmd_partitioner_controlled(points: Array) -> bool:
     """True when ``points`` lives on (or is traced under) a multi-device
     mesh whose axes the XLA SPMD partitioner controls.
 
-    Three cases, via sharding-in-types avals (jax >= 0.9):
-    - concrete array committed to >1 device: the implicit jit around the
-      kernel would need the partitioner -> True;
-    - tracer whose aval mesh is non-empty with any Auto/Explicit axis
-      (plain ``jit`` under a mesh): the partitioner will place this op ->
-      True;
-    - tracer under ``shard_map`` (all axes Manual) or no mesh at all: the
-      kernel sees a per-device local block -> False.
+    Concrete arrays are easy on every JAX: committed to >1 device means
+    the implicit jit around the kernel would need the partitioner -> True.
+    Tracers split by JAX generation:
+
+    - sharding-in-types avals (jax >= 0.6): aval mesh non-empty with any
+      Auto/Explicit axis (plain ``jit`` under a mesh) -> the partitioner
+      will place this op -> True; under ``shard_map`` (all axes Manual)
+      or with no mesh -> the kernel sees a per-device local block ->
+      False.
+    - legacy avals (jax <= 0.4.x, no sharding on tracers): inside
+      ``shard_map``/``pmap`` the mesh axes are bound as named axis frames
+      (``jax_compat.manual_axis_context``) -> local block -> False;
+      under plain ``jit`` the tracer cannot reveal its placement, so on a
+      multi-device process we conservatively assume the partitioner may
+      control it -> True (sharded training re-enters through the
+      shard_map wrappers in ``parallel/``, where Pallas is selected
+      again; only a single-process plain-jit multi-device run pays the
+      xla fallback). Single device -> False.
     """
     if not isinstance(points, jax.core.Tracer):
         sharding = getattr(points, "sharding", None)
         return sharding is not None and len(sharding.device_set) > 1
     aval = getattr(points, "aval", None)
-    mesh = getattr(getattr(aval, "sharding", None), "mesh", None)
-    if mesh is None or not mesh.axis_types:
+    aval_sharding = getattr(aval, "sharding", None)
+    if aval_sharding is not None:
+        mesh = getattr(aval_sharding, "mesh", None)
+        if mesh is None or not getattr(mesh, "axis_types", None):
+            return False
+        axis_type = jax.sharding.AxisType
+        return any(t != axis_type.Manual for t in mesh.axis_types)
+    if manual_axis_context():
         return False
-    return any(t != jax.sharding.AxisType.Manual for t in mesh.axis_types)
+    return len(jax.devices()) > 1
 
 
 def knn_batch(

@@ -44,7 +44,7 @@ run, including MID-stage (the partially-walked stage is not resampled).
 Operationally critical on the short-window tunneled chip, where the
 K-candidate curriculum is the longest stage in the validation queue.
 An optional ``mesh={dp: D}`` shards the member axis over devices
-(``jax.shard_map``, K % D == 0), which is the 7th ``dryrun_multichip``
+(``jax_compat.shard_map``, K % D == 0), which is the 7th ``dryrun_multichip``
 path (__graft_entry__.py).
 """
 
@@ -64,6 +64,7 @@ from marl_distributedformation_tpu.env.hetero import (
     hetero_compute_obs,
     hetero_reset_batch,
 )
+from marl_distributedformation_tpu.jax_compat import shard_map
 from marl_distributedformation_tpu.models import MLPActorCritic
 from marl_distributedformation_tpu.train.curriculum import (
     Curriculum,
@@ -201,7 +202,7 @@ class HeteroSweepTrainer:
             from jax.sharding import PartitionSpec
 
             spec = PartitionSpec("dp")
-            iteration_pop = jax.shard_map(
+            iteration_pop = shard_map(
                 iteration_pop,
                 mesh=mesh,
                 in_specs=spec,

@@ -61,6 +61,7 @@ from marl_distributedformation_tpu.env.formation import (
     compute_obs,
     reset_batch,
 )
+from marl_distributedformation_tpu.jax_compat import shard_map
 from marl_distributedformation_tpu.models import MLPActorCritic
 from marl_distributedformation_tpu.train.trainer import (
     TrainConfig,
@@ -282,7 +283,7 @@ class SweepTrainer:
             from jax.sharding import PartitionSpec
 
             spec = PartitionSpec("dp")
-            iteration_pop = jax.shard_map(
+            iteration_pop = shard_map(
                 iteration_pop,
                 mesh=mesh,
                 in_specs=spec,

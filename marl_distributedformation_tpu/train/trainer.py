@@ -13,6 +13,7 @@ is ``5000 * num_formations`` (vectorized_env.py:116,134).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Any, Dict, Optional, Tuple
@@ -31,6 +32,7 @@ from marl_distributedformation_tpu.algo import (
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.env.formation import compute_obs, reset_batch
 from marl_distributedformation_tpu.models import MLPActorCritic
+from marl_distributedformation_tpu.utils import profiling
 from marl_distributedformation_tpu.utils import (
     MetricsLogger,
     Throughput,
@@ -68,6 +70,15 @@ class TrainConfig:
     profile: bool = False  # capture a jax.profiler trace of a few
     #   post-warmup iterations into {log_dir}/profile/ (profile=true CLI)
     profile_iterations: int = 3
+    # Runtime tracing guards (analysis/guards.py; docs/static_analysis.md).
+    guard_retraces: int = 0  # >0: fail the run if the jitted train
+    #   iteration compiles more than this many times (1 = the steady-state
+    #   contract: identical shapes must never retrace). 0 = count only.
+    guard_transfers: bool = False  # disallow device->host transfers during
+    #   post-warmup dispatches (the compile dispatch is exempt — constant
+    #   uploads during tracing are legitimate)
+    guard_nans: bool = False  # jax_debug_nans around every dispatch: ops
+    #   producing NaN re-run op-by-op and raise at the source op
 
 
 def default_total_timesteps(config: "TrainConfig") -> int:
@@ -317,15 +328,24 @@ class Trainer:
         self._vec_steps_since_save = 0
         self._iteration_core = self._make_iteration()
         self._iters_per_dispatch = max(1, int(config.iters_per_dispatch))
-        if self._iters_per_dispatch > 1:
-            self._iteration = jax.jit(
-                _burst(self._iteration_core, self._iters_per_dispatch),
-                donate_argnums=(0, 1),
-            )
-        else:
-            self._iteration = jax.jit(
-                self._iteration_core, donate_argnums=(0, 1)
-            )
+        dispatch_fn = (
+            _burst(self._iteration_core, self._iters_per_dispatch)
+            if self._iters_per_dispatch > 1
+            else self._iteration_core
+        )
+        # Retrace guard (analysis/guards.py): counts every compilation of
+        # the outermost jitted dispatch; with guard_retraces=N the trace
+        # that exceeds N raises RetraceError naming the drifting argument
+        # signature. Always counting (budget or not) costs one Python
+        # closure call per COMPILE, i.e. nothing per step.
+        self.retrace_guard = profiling.RetraceGuard(
+            "train_iteration",
+            max_traces=config.guard_retraces or None,
+        )
+        self._iteration = jax.jit(
+            self.retrace_guard.wrap(dispatch_fn), donate_argnums=(0, 1)
+        )
+        self._dispatches = 0
 
         self.log_dir = config.log_dir or str(
             repo_root() / "logs" / config.name
@@ -355,13 +375,24 @@ class Trainer:
         """One dispatch — ``iters_per_dispatch`` rollout+update cycles
         (1 by default); returns device metrics (burst-averaged when
         fused)."""
-        (
-            self.train_state,
-            self.env_state,
-            self.obs,
-            self.key,
-            metrics,
-        ) = self._iteration(self.train_state, self.env_state, self.obs, self.key)
+        with contextlib.ExitStack() as stack:
+            if self.config.guard_transfers and self._dispatches > 0:
+                # Post-warmup only: the compile dispatch legitimately
+                # uploads trace-time constants; from the second dispatch
+                # on, any device->host sync in here is a hot-loop bug.
+                stack.enter_context(profiling.no_host_transfers())
+            if self.config.guard_nans:
+                stack.enter_context(profiling.nan_guard())
+            (
+                self.train_state,
+                self.env_state,
+                self.obs,
+                self.key,
+                metrics,
+            ) = self._iteration(
+                self.train_state, self.env_state, self.obs, self.key
+            )
+        self._dispatches += 1
         r = self._iters_per_dispatch
         self.num_timesteps += r * self.ppo.n_steps * self.num_envs
         self._vec_steps_since_save += r * self.ppo.n_steps
@@ -380,26 +411,28 @@ class Trainer:
         last_record: Dict[str, float] = {}
         iteration = 0
         # profile=true: trace a few post-warmup iterations (iteration 1 is
-        # compile-bound and would dominate the trace).
-        profiling = False
+        # compile-bound and would dominate the trace). NB: named
+        # trace_active, not "profiling" — that name is the utils.profiling
+        # module import at the top of this file.
+        trace_active = False
         profile_stop = 1 + max(1, self.config.profile_iterations)
         try:
             while self.num_timesteps < self.total_timesteps:
-                if self.config.profile and iteration == 1 and not profiling:
+                if self.config.profile and iteration == 1 and not trace_active:
                     import os
 
                     profile_dir = os.path.join(self.log_dir, "profile")
                     jax.profiler.start_trace(profile_dir)
-                    profiling = True
+                    trace_active = True
                     print(f"[trainer] tracing -> {profile_dir}")
                 metrics = self.run_iteration()
                 iteration += 1
-                if profiling and iteration >= profile_stop:
+                if trace_active and iteration >= profile_stop:
                     jax.tree_util.tree_map(
                         lambda x: x.block_until_ready(), metrics
                     )
                     jax.profiler.stop_trace()
-                    profiling = False
+                    trace_active = False
                 meter.tick(
                     self._iters_per_dispatch
                     * self.ppo.n_steps
@@ -425,7 +458,7 @@ class Trainer:
             if self.config.checkpoint:
                 self.save()
         finally:
-            if profiling:
+            if trace_active:
                 jax.profiler.stop_trace()
             logger.close()
         return last_record

@@ -18,6 +18,18 @@ from typing import Iterator, Optional
 
 import jax
 
+# Runtime tracing guards (the dynamic half of graftlint — see
+# analysis/guards.py and docs/static_analysis.md): re-exported here so
+# training code and notebooks reach them through the same module that
+# owns the other observability hooks. Opt-in from TrainConfig via
+# guard_retraces / guard_transfers / guard_nans.
+from marl_distributedformation_tpu.analysis.guards import (  # noqa: F401
+    RetraceError,
+    RetraceGuard,
+    nan_guard,
+    no_host_transfers,
+)
+
 
 @contextlib.contextmanager
 def trace(log_dir: Optional[str]) -> Iterator[None]:

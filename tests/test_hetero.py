@@ -249,12 +249,15 @@ class TestWeightedPPO:
         params = model.init(key, jnp.zeros((1, 8)))
         cfg = PPOConfig()
         b = 16
+        # Independent draws per field (graftlint prng-key-reuse: one key
+        # across all five would correlate advantages with returns etc.).
+        ks = jax.random.split(key, 5)
         data = dict(
-            obs=jax.random.normal(key, (b, 8)),
-            actions=jax.random.normal(key, (b, 2)),
-            old_log_probs=jax.random.normal(key, (b,)),
-            advantages=jax.random.normal(key, (b,)),
-            returns=jax.random.normal(key, (b,)),
+            obs=jax.random.normal(ks[0], (b, 8)),
+            actions=jax.random.normal(ks[1], (b, 2)),
+            old_log_probs=jax.random.normal(ks[2], (b,)),
+            advantages=jax.random.normal(ks[3], (b,)),
+            returns=jax.random.normal(ks[4], (b,)),
         )
         loss_none, _ = ppo_loss(
             params, model.apply, MinibatchData(**data), cfg

@@ -262,3 +262,87 @@ def test_throughput_windowed_rate():
     rate = meter.rate()
     # ~10 steps / 10ms = ~1000/s; generous bounds for CI jitter
     assert 200 < rate < 5000, rate
+
+
+# ---------------------------------------------------------------------------
+# Runtime tracing guards (analysis/guards.py, opt-in via TrainConfig)
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_guard_train_step_compiles_exactly_once(tmp_path):
+    """The steady-state contract the retrace guard enforces: the jitted
+    train iteration compiles on the first dispatch and NEVER again for
+    identical shapes — a second iteration triggers zero recompiles (with
+    guard_retraces=1, a retrace would raise RetraceError instead of
+    silently eating a multi-second compile per iteration)."""
+    trainer = tiny_trainer(tmp_path, checkpoint=False, guard_retraces=1)
+    trainer.run_iteration()
+    assert trainer.retrace_guard.count == 1, "first dispatch = one compile"
+    trainer.run_iteration()  # identical shapes: cache hit, no retrace
+    assert trainer.retrace_guard.count == 1, (
+        "second dispatch with identical shapes must not retrace"
+    )
+
+
+def test_retrace_guard_raises_past_budget():
+    from marl_distributedformation_tpu.utils.profiling import (
+        RetraceError,
+        RetraceGuard,
+    )
+
+    guard = RetraceGuard("toy", max_traces=1)
+    f = jax.jit(guard.wrap(lambda x: x * 2))
+    f(np.zeros((2,), np.float32))
+    f(np.ones((2,), np.float32))  # same shape: cache hit
+    assert guard.count == 1
+    with pytest.raises(RetraceError, match="toy"):
+        f(np.zeros((3,), np.float32))  # shape drift forces a retrace
+    guard.reset()
+    assert guard.count == 0
+
+
+def test_transfer_guard_blocks_host_sync():
+    """On accelerator backends a device->host sync under the guard must
+    raise; the XLA CPU backend aliases device and host memory (zero-copy
+    readbacks), so there the guard is a documented no-op and this test
+    pins only the clean enter/exit contract."""
+    from marl_distributedformation_tpu.utils.profiling import (
+        no_host_transfers,
+    )
+
+    x = jax.jit(lambda v: v + 1)(np.arange(4.0, dtype=np.float32))
+    if jax.default_backend() == "cpu":
+        with no_host_transfers():
+            pass  # inert on CPU; must still nest/exit cleanly
+    else:
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            with no_host_transfers():
+                float(x.sum())  # device->host sync must be rejected
+    assert float(x.sum()) == 10.0  # guard lifts cleanly on exit
+
+
+def test_guarded_trainer_iterations_are_transfer_free(tmp_path):
+    """guard_transfers=true: post-warmup dispatches run under the
+    device->host transfer guard — proving the hot loop never syncs."""
+    trainer = tiny_trainer(
+        tmp_path, checkpoint=False, guard_transfers=True, guard_nans=True
+    )
+    for _ in range(3):
+        metrics = trainer.run_iteration()
+    # metrics stay device arrays inside the loop; the (legal) sync
+    # happens only here, outside the guarded region.
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+def test_nan_guard_restores_previous_setting():
+    from marl_distributedformation_tpu.utils.profiling import nan_guard
+
+    before = jax.config.jax_debug_nans
+    with nan_guard(True):
+        assert jax.config.jax_debug_nans is True
+        with pytest.raises(FloatingPointError):
+            jnp_div = jax.jit(lambda a, b: a / b)
+            jax.block_until_ready(
+                jnp_div(np.float32(0.0), np.float32(0.0))
+            )
+    assert jax.config.jax_debug_nans == before

@@ -60,6 +60,11 @@ def train_config_from_config(cfg) -> TrainConfig:
         log_interval=cfg.log_interval,
         profile=bool(cfg.get("profile", False)),
         iters_per_dispatch=int(cfg.get("iters_per_dispatch", 1)),
+        # Runtime tracing guards (analysis/guards.py): guard_retraces=1
+        # enforces the compiles-exactly-once contract on the train step.
+        guard_retraces=int(cfg.get("guard_retraces", 0)),
+        guard_transfers=bool(cfg.get("guard_transfers", False)),
+        guard_nans=bool(cfg.get("guard_nans", False)),
     )
 
 
