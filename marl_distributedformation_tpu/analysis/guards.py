@@ -91,7 +91,18 @@ class RetraceGuard:
                     "recompilation every call; offending signature: "
                     f"[{self._describe(args, kwargs)}]"
                 )
-            return fn(*args, **kwargs)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                # A trace that raises produced no compiled program (and
+                # no jit cache entry), so it must not consume budget —
+                # otherwise one malformed call poisons the target for
+                # every valid caller after it (the serving engine leans
+                # on this: budget-1 per bucket must mean one SUCCESSFUL
+                # compile, not one attempt).
+                with self._lock:
+                    self.count -= 1
+                raise
 
         return traced
 

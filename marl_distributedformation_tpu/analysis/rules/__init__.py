@@ -17,6 +17,9 @@ from marl_distributedformation_tpu.analysis.rules.host_sync import HostSyncInJit
 from marl_distributedformation_tpu.analysis.rules.numpy_use import NumpyInJit
 from marl_distributedformation_tpu.analysis.rules.printing import PrintInJit
 from marl_distributedformation_tpu.analysis.rules.prng import PrngKeyReuse
+from marl_distributedformation_tpu.analysis.rules.scan_carry import (
+    ScanCarryWeakType,
+)
 
 RULES = (
     NumpyInJit(),
@@ -27,6 +30,7 @@ RULES = (
     DeprecatedApi(),
     MissingDonate(),
     PrintInJit(),
+    ScanCarryWeakType(),
 )
 
 

@@ -1,0 +1,90 @@
+"""scan-carry-weak-type: Python scalar literals as ``lax.scan`` carry
+leaves.
+
+A Python ``0`` / ``0.0`` in the scan init is a *weak-typed* scalar.
+Inside the loop the carry participates in arithmetic, picks up a strong
+dtype, and comes back different from what went in — either an explicit
+scan carry-mismatch error, or (the silent version, when the weak leaf
+rides through unchanged this trace) a program whose input aval depends
+on Python-number promotion rules, where the next call site that passes a
+strongly-typed value retraces the whole jitted program. The fix costs
+one call: ``jnp.asarray(0.0, jnp.float32)`` (or ``jnp.zeros_like``)
+pins the carry dtype at the boundary.
+
+Only literals reachable through plain containers (tuples/lists/dicts and
+a unary sign) are flagged: a literal *inside a call* —
+``jnp.zeros((3, 4))``, ``jnp.float32(0.0)`` — feeds a constructor that
+returns a strong-typed array, which is exactly the fix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+_SCAN_NAMES = frozenset({"jax.lax.scan", "lax.scan"})
+_CONTAINERS = (ast.Tuple, ast.List, ast.Dict, ast.Set)
+
+
+def _literal_leaves(node: ast.AST) -> Iterator[ast.Constant]:
+    """Numeric literals that become carry *leaves* of this init
+    expression: the node itself, or literals reached through container
+    displays and unary signs. Calls/comprehensions/etc. break the walk —
+    their result is whatever the expression constructs."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, (bool, int, float, complex)):
+            yield node
+        return
+    if isinstance(node, ast.UnaryOp):  # -1.0 parses as USub(Constant)
+        yield from _literal_leaves(node.operand)
+        return
+    if isinstance(node, ast.Dict):
+        # Only VALUES are pytree leaves; int/str keys are structure.
+        for value in node.values:
+            yield from _literal_leaves(value)
+        return
+    if isinstance(node, _CONTAINERS):
+        for child in ast.iter_child_nodes(node):
+            yield from _literal_leaves(child)
+
+
+class ScanCarryWeakType(Rule):
+    name = "scan-carry-weak-type"
+    default_severity = "error"
+    description = (
+        "lax.scan carry initialized from a Python scalar literal — the "
+        "weak-typed leaf promotes inside the body and forces a carry "
+        "mismatch or a retrace per call; pin the dtype with jnp.asarray"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in _SCAN_NAMES:
+                continue
+            init = None
+            if len(node.args) >= 2:
+                init = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "init":
+                        init = kw.value
+            if init is None:
+                continue
+            for leaf in _literal_leaves(init):
+                yield (
+                    leaf.lineno,
+                    leaf.col_offset,
+                    f"scan carry leaf `{ast.unparse(leaf)}` is a "
+                    "weak-typed Python scalar — promotion inside the "
+                    "body mismatches the carry (or silently retraces "
+                    "per call); pin it with jnp.asarray(..., dtype) or "
+                    "jnp.zeros_like",
+                )

@@ -1,0 +1,59 @@
+"""TPU-native policy inference serving (the north-star's missing layer).
+
+Training produces checkpoints; until now the only inference paths were
+the offline ``eval.py`` rollout harness and the per-call, unbatched
+``compat.policy.LoadedPolicy.predict``. This package serves those
+checkpoints to concurrent callers the way Podracer (arXiv:2104.06272)
+serves actors — large fixed-shape batched inference that keeps the
+accelerator saturated — with the host-side request path JaxMARL
+(arXiv:2311.10090) shows becomes the bottleneck once the policy itself
+is compiled:
+
+- :class:`~.engine.BucketedPolicyEngine` — donated, jit-compiled act
+  functions over a small ladder of bucketed batch shapes; arbitrary
+  request sizes pad to the next bucket so each bucket compiles exactly
+  once (pinned by ``analysis.guards.RetraceGuard``).
+- :class:`~.scheduler.MicroBatchScheduler` — bounded request queue that
+  coalesces concurrent requests within a deadline window, with
+  backpressure (reject-with-retry-after) and per-request timeouts.
+- :class:`~.registry.ModelRegistry` — watches a ``logs/{name}/``
+  directory via ``utils.checkpoint.latest_checkpoint`` and hot-swaps new
+  checkpoints atomically between batches; in-flight requests finish on
+  the params they were dispatched with.
+- :class:`~.metrics.ServingMetrics` — queue depth, batch occupancy,
+  latency percentiles, swap count; emitted through
+  ``utils.logging.MetricsLogger``.
+- :class:`~.client.ServingClient` — the in-process client (used by tests
+  and the ``scripts/serve_policy.py`` smoke benchmark).
+
+Architecture, bucket-ladder sizing, backpressure semantics, and the
+hot-reload contract are documented in ``docs/serving.md``.
+"""
+
+from marl_distributedformation_tpu.serving.client import ServingClient
+from marl_distributedformation_tpu.serving.engine import (
+    DEFAULT_BUCKETS,
+    BucketedPolicyEngine,
+)
+from marl_distributedformation_tpu.serving.metrics import ServingMetrics
+from marl_distributedformation_tpu.serving.registry import ModelRegistry
+from marl_distributedformation_tpu.serving.scheduler import (
+    BackpressureError,
+    MicroBatchScheduler,
+    RequestTimeout,
+    ServedResult,
+)
+from marl_distributedformation_tpu.serving.smoke import run_smoke_benchmark
+
+__all__ = [
+    "BackpressureError",
+    "BucketedPolicyEngine",
+    "DEFAULT_BUCKETS",
+    "MicroBatchScheduler",
+    "ModelRegistry",
+    "RequestTimeout",
+    "ServedResult",
+    "ServingClient",
+    "ServingMetrics",
+    "run_smoke_benchmark",
+]

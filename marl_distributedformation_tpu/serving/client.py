@@ -1,0 +1,76 @@
+"""In-process serving client: the caller-side contract in one place.
+
+``predict`` is deliberately SB3-shaped (obs in, actions out) so code
+written against ``compat.policy.LoadedPolicy.predict`` ports by changing
+one constructor. On top of the raw future API it adds the two behaviors
+every well-behaved caller needs:
+
+- **honor backpressure** — on :class:`BackpressureError` it sleeps the
+  server-priced ``retry_after_s`` and retries, up to ``max_retries``
+  times, instead of hammering a full queue;
+- **bounded waiting** — the future wait is capped by the request's own
+  timeout plus the retry budget, so a caller can never hang on a dead
+  server.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from marl_distributedformation_tpu.serving.scheduler import (
+    BackpressureError,
+    MicroBatchScheduler,
+    ServedResult,
+)
+
+
+class ServingClient:
+    def __init__(
+        self, scheduler: MicroBatchScheduler, max_retries: int = 3
+    ) -> None:
+        self.scheduler = scheduler
+        self.max_retries = max_retries
+
+    def predict(
+        self,
+        obs: np.ndarray,
+        deterministic: bool = True,
+        timeout_s: Optional[float] = None,
+    ) -> Tuple[np.ndarray, int]:
+        """Blocking predict; returns ``(actions, model_step)``.
+
+        Raises ``RequestTimeout`` when the request's deadline passes,
+        ``BackpressureError`` when the queue stayed full through every
+        retry."""
+        result = self.predict_full(obs, deterministic, timeout_s)
+        return result.actions, result.model_step
+
+    def predict_full(
+        self,
+        obs: np.ndarray,
+        deterministic: bool = True,
+        timeout_s: Optional[float] = None,
+    ) -> ServedResult:
+        wait_s = (
+            timeout_s
+            if timeout_s is not None
+            else self.scheduler.default_timeout_s
+        )
+        for attempt in range(self.max_retries + 1):
+            try:
+                future = self.scheduler.submit(
+                    obs, deterministic=deterministic, timeout_s=timeout_s
+                )
+            except BackpressureError as e:
+                if attempt == self.max_retries:
+                    raise
+                time.sleep(e.retry_after_s)
+                continue
+            # Slack over the request's own deadline: the scheduler fails
+            # expired requests itself; this outer bound only covers a
+            # wedged worker.
+            return future.result(timeout=wait_s + 5.0)
+        raise AssertionError("unreachable")  # pragma: no cover

@@ -1,0 +1,188 @@
+"""Bucketed, jit-compiled policy act functions — the compiled core of
+the serving stack.
+
+Why buckets: a jitted function compiles one XLA program per input
+*shape*. Serving traffic arrives at arbitrary batch sizes, and compiling
+a multi-hundred-millisecond program per distinct size is the classic
+silent serving killer (the same failure mode graftlint's RetraceGuard
+exists to catch in training). The engine therefore compiles a small
+ladder of fixed batch shapes — 1/8/64/512 by default — and pads every
+request batch up to the next rung, so the total number of compilations
+is bounded by ``len(buckets)`` for the lifetime of the process, no
+matter what sizes clients send. Each bucket's act function is wrapped in
+a :class:`RetraceGuard` with a budget of one trace; a retrace (weak-type
+drift, dtype drift, a params structure change) raises instead of
+silently recompiling per call.
+
+Params are an *argument* of the compiled function, not a closure
+constant: a hot-swapped checkpoint with the same architecture reuses the
+existing executable — swapping weights never recompiles. The padded
+observation buffer and the per-dispatch PRNG key are donated (both are
+freshly built per call, so the engine never aliases a live buffer).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from marl_distributedformation_tpu.analysis.guards import RetraceGuard
+from marl_distributedformation_tpu.models import distributions
+
+# Powers-of-8-ish ladder: adjacent rungs are 8x apart, so padding waste
+# is bounded (worst-case occupancy 1/8 just above a rung) while the
+# compile count stays at 4 programs. See docs/serving.md for sizing.
+DEFAULT_BUCKETS = (1, 8, 64, 512)
+
+
+class BucketedPolicyEngine:
+    """jit-compiled ``act`` over a ladder of fixed batch shapes.
+
+    Args:
+      policy: a ``compat.policy.LoadedPolicy`` (or anything with
+        ``.model`` / ``.params`` of the same contract: ``model.apply``
+        returns ``(mean, log_std, value)`` and is shape-polymorphic over
+        leading batch axes).
+      buckets: ascending batch-size ladder. Requests larger than the top
+        rung are split into top-rung chunks plus a bucketed remainder.
+      max_traces_per_bucket: RetraceGuard budget per rung. The default of
+        1 is the serving contract — one bucket, one compile, ever; a
+        second trace raises ``RetraceError`` naming the drifting
+        signature.
+      seed: base PRNG key for stochastic (non-deterministic) actions; a
+        per-dispatch key is derived via ``fold_in`` on a dispatch
+        counter, so no key is ever consumed twice.
+    """
+
+    def __init__(
+        self,
+        policy: Any,
+        buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
+        max_traces_per_bucket: Optional[int] = 1,
+        seed: int = 0,
+    ) -> None:
+        self.policy = policy
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.guards: Dict[int, RetraceGuard] = {
+            b: RetraceGuard(
+                f"serving-act-bucket{b}", max_traces=max_traces_per_bucket
+            )
+            for b in self.buckets
+        }
+        self._acts = {b: self._build_act(b) for b in self.buckets}
+        self._base_key = jax.random.PRNGKey(seed)
+        self._dispatches = 0
+        self._lock = threading.Lock()
+        # Trailing row shape, recorded on the first successful dispatch:
+        # later mismatches fail fast as a ValueError instead of burning
+        # a trace attempt inside jit.
+        self._row_shape: Optional[Tuple[int, ...]] = None
+
+    # -- compiled path --------------------------------------------------
+
+    def _build_act(self, bucket: int):
+        model = self.policy.model
+
+        def _act(nn_params, obs, key, deterministic):
+            mean, log_std, _ = model.apply(nn_params, obs)
+            sampled = distributions.sample(key, mean, log_std)
+            actions = jnp.where(
+                deterministic, distributions.mode(mean), sampled
+            )
+            # Action-space clip, same contract as LoadedPolicy.predict.
+            return jnp.clip(actions, -1.0, 1.0)
+
+        # obs + key are freshly materialized per dispatch — donate both.
+        # ``deterministic`` rides as a traced bool scalar so ONE program
+        # per bucket covers both modes (a static arg would double the
+        # compile count for no win: the sampled branch is a cheap fused
+        # normal draw). The CPU backend cannot alias input buffers
+        # (donation there only emits a warning per compile), so donation
+        # engages on accelerators only.
+        donate = () if jax.default_backend() == "cpu" else (1, 2)
+        return jax.jit(
+            self.guards[bucket].wrap(_act), donate_argnums=donate
+        )
+
+    # -- bucketing ------------------------------------------------------
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest rung holding ``n`` rows (``n`` <= max_bucket)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"{n} rows exceed the top bucket {self.max_bucket}")
+
+    def plan(self, n: int) -> List[int]:
+        """Rung sizes a dispatch of ``n`` rows pads into (top-rung chunks
+        plus one bucketed remainder). ``sum(plan)`` is the padded
+        capacity the batch occupies — the occupancy denominator."""
+        if n <= 0:
+            raise ValueError(f"need at least one row, got {n}")
+        chunks = [self.max_bucket] * (n // self.max_bucket)
+        rest = n % self.max_bucket
+        if rest:
+            chunks.append(self.bucket_for(rest))
+        return chunks
+
+    def compile_counts(self) -> Dict[int, int]:
+        """Traces per rung so far (the serving contract: at most 1 each)."""
+        return {b: g.count for b, g in self.guards.items()}
+
+    # -- host-side dispatch ---------------------------------------------
+
+    def _next_key(self) -> jax.Array:
+        with self._lock:
+            count = self._dispatches
+            self._dispatches += 1
+        return jax.random.fold_in(self._base_key, count)
+
+    def act(
+        self,
+        obs: np.ndarray,
+        deterministic: bool = True,
+        nn_params: Any = None,
+    ) -> np.ndarray:
+        """Actions for ``obs`` rows ``(n, *row_shape)``; pads to the next
+        bucket, runs the compiled rung, slices the padding back off.
+        ``nn_params=None`` uses the wrapped policy's own params (the
+        registry passes its active snapshot instead)."""
+        if nn_params is None:
+            nn_params = self.policy.params
+        obs = np.asarray(obs, np.float32)
+        if obs.ndim < 2:
+            raise ValueError(
+                f"obs must be (n, *row_shape) with a leading batch axis, "
+                f"got shape {obs.shape}"
+            )
+        n = obs.shape[0]
+        if self._row_shape is not None and obs.shape[1:] != self._row_shape:
+            raise ValueError(
+                f"obs rows have shape {obs.shape[1:]}; this engine serves "
+                f"{self._row_shape} rows (one compiled row shape per "
+                "engine — the bucket ladder is the only shape axis)"
+            )
+        det = np.bool_(deterministic)  # strong dtype: no weak-type retrace
+        outs: List[np.ndarray] = []
+        start = 0
+        for bucket in self.plan(n):
+            k = min(bucket, n - start)
+            padded = np.zeros((bucket,) + obs.shape[1:], np.float32)
+            padded[:k] = obs[start : start + k]
+            actions = self._acts[bucket](
+                nn_params, padded, self._next_key(), det
+            )
+            outs.append(np.asarray(actions)[:k])
+            start += k
+        self._row_shape = obs.shape[1:]
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)

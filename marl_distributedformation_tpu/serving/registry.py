@@ -1,0 +1,192 @@
+"""Checkpoint watcher + atomic hot swap: the model side of serving.
+
+The trainer drops ``rl_model_{steps}_steps.msgpack`` files into
+``logs/{name}/`` (atomically — ``utils.checkpoint._write_atomic`` writes
+a dot-prefixed temp file and renames, so discovery can never observe a
+torn checkpoint). The registry polls that directory with
+``latest_checkpoint`` and, when a newer step appears, restores it
+against the serving template and swaps the active params under a lock.
+
+Swap semantics (the hot-reload contract, docs/serving.md):
+
+- **Atomic between batches** — the scheduler snapshots
+  ``(params, step)`` once per micro-batch via :meth:`active`; a swap
+  lands between snapshots, so every request in a batch is answered by
+  exactly one model version and in-flight batches finish on the params
+  they were dispatched with.
+- **Same architecture only** — the restore is validated leaf-by-leaf
+  against the live params (``restore_checkpoint_partial``), so a
+  mismatched-architecture checkpoint is a clean recorded error, not a
+  shape crash inside a compiled act function. The engine's jit cache is
+  keyed on param shapes, which the validation holds fixed — a swap
+  therefore never recompiles.
+- **Never go backward, never go down** — older/equal steps are ignored,
+  and any load failure keeps the previous params serving (the error is
+  appended to :attr:`load_errors` and counted).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Deque, Optional, Tuple
+
+from marl_distributedformation_tpu.compat.policy import (
+    LoadedPolicy,
+    load_checkpoint_raw,
+)
+from marl_distributedformation_tpu.utils.checkpoint import (
+    checkpoint_step,
+    latest_checkpoint,
+    restore_state_dict_partial,
+)
+
+
+class ModelRegistry:
+    """Serve-side view of one checkpoint directory.
+
+    Args:
+      log_dir: the ``logs/{name}/`` directory the trainer checkpoints to.
+      policy: optionally a pre-built ``LoadedPolicy``; by default the
+        newest checkpoint in ``log_dir`` is loaded (``env_params`` /
+        ``act_dim`` forwarded to ``LoadedPolicy.from_checkpoint``).
+      poll_interval_s: cadence of the background watcher thread
+        (``start()``); ``refresh()`` may also be called directly.
+    """
+
+    def __init__(
+        self,
+        log_dir: str | Path,
+        policy: Optional[LoadedPolicy] = None,
+        env_params: Any = None,
+        act_dim: int = 2,
+        poll_interval_s: float = 2.0,
+        max_recorded_errors: int = 32,
+    ) -> None:
+        import jax
+
+        self.log_dir = Path(log_dir)
+        if policy is None:
+            path = latest_checkpoint(self.log_dir)
+            if path is None:
+                raise FileNotFoundError(
+                    f"no rl_model_*_steps.msgpack checkpoint under "
+                    f"{self.log_dir} to serve"
+                )
+            policy = LoadedPolicy.from_checkpoint(
+                path, act_dim=act_dim, env_params=env_params
+            )
+            step = checkpoint_step(path)
+        else:
+            # A pre-built policy's provenance is unknown — report step 0
+            # so the first refresh() upgrades to whatever newest
+            # checkpoint the directory holds (claiming the newest
+            # on-disk step here would both mislabel results and block
+            # that upgrade forever).
+            step = 0
+        self.policy = policy
+        # Params live on device from the start: msgpack restores host
+        # numpy trees, and handing those to the jitted act function
+        # would re-upload the full weight tree every micro-batch.
+        policy.params = jax.device_put(policy.params)
+        self.poll_interval_s = poll_interval_s
+        self.swap_count = 0
+        self.load_errors: Deque[Tuple[str, str]] = deque(
+            maxlen=max_recorded_errors
+        )
+        self._lock = threading.Lock()
+        self._params = policy.params
+        self._step = step
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- serving snapshot -----------------------------------------------
+
+    def active(self) -> Tuple[Any, int]:
+        """The ``(params, step)`` snapshot a micro-batch dispatches with."""
+        with self._lock:
+            return self._params, self._step
+
+    @property
+    def active_step(self) -> int:
+        """Checkpoint step of the params currently serving (version
+        pinning: every ``ServedResult`` carries the step it was computed
+        with)."""
+        with self._lock:
+            return self._step
+
+    # -- reload ---------------------------------------------------------
+
+    def refresh(self) -> bool:
+        """Check the directory once; swap if a newer checkpoint landed.
+        Returns True on swap. Load failures (torn files are impossible by
+        the atomic-write contract, but architecture mismatches and
+        foreign files are not) keep the old params serving and are
+        recorded in ``load_errors``."""
+        path = latest_checkpoint(self.log_dir)
+        if path is None:
+            return False
+        step = checkpoint_step(path)
+        if step <= self.active_step:
+            return False
+        try:
+            raw = load_checkpoint_raw(path)
+            want = type(self.policy.model).__name__
+            got = raw.get("policy", want)
+            if got != want:
+                raise ValueError(
+                    f"checkpoint {path} was trained with policy {got!r}; "
+                    f"this registry serves {want!r}"
+                )
+            restored = restore_state_dict_partial(
+                raw, {"params": self._params}, origin=str(path)
+            )
+        except Exception as e:  # noqa: BLE001 — serving must not die
+            self.load_errors.append((str(path), repr(e)))
+            return False
+        import jax
+
+        # One host->device transfer at swap time; dispatches then reuse
+        # device-resident buffers instead of re-uploading per batch.
+        params = jax.device_put(restored["params"])
+        with self._lock:
+            if step <= self._step:
+                # A concurrent refresh (watcher thread vs. a manual
+                # call) finished a newer load while this one was
+                # reading/validating — never swap backward.
+                return False
+            self._params = params
+            self._step = step
+            self.swap_count += 1
+        return True
+
+    # -- background watcher ---------------------------------------------
+
+    def start(self) -> "ModelRegistry":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch, name="model-registry-watch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        self._thread = None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.refresh()
+
+    def __enter__(self) -> "ModelRegistry":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

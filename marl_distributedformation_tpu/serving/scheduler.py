@@ -1,0 +1,319 @@
+"""Micro-batching request scheduler: the host-side half of serving.
+
+One worker thread owns the accelerator. Clients enqueue requests into a
+bounded queue; the worker takes the first request, then keeps absorbing
+arrivals until the coalescing deadline (``window_ms``) passes or the top
+bucket is full, and dispatches the coalesced rows through the engine as
+ONE padded batch. Per-request results are sliced back out and resolved
+on each caller's future.
+
+The three failure-shaped paths are explicit:
+
+- **Backpressure** — a full queue rejects immediately with
+  :class:`BackpressureError` carrying ``retry_after_s`` (priced from the
+  current depth times the recent mean batch time). Rejecting at the door
+  beats queueing unboundedly: the caller knows *now* and the p99 of
+  accepted requests stays bounded.
+- **Per-request timeouts** — a request whose deadline passed while it
+  waited is failed with :class:`RequestTimeout` at dispatch time (never
+  silently computed for a caller that already gave up).
+- **Dispatch errors** — an engine exception fails that batch's futures
+  and the worker keeps serving; a serving process never dies with
+  requests in flight.
+
+Model hot-swap composes here: the worker snapshots ``(params, step)``
+from the registry once per micro-batch, so a swap lands atomically
+between batches and every result records the checkpoint step that
+produced it (``ServedResult.model_step``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, List, Optional
+
+import numpy as np
+
+from marl_distributedformation_tpu.serving.engine import BucketedPolicyEngine
+from marl_distributedformation_tpu.serving.metrics import ServingMetrics
+
+
+class BackpressureError(RuntimeError):
+    """Queue full: retry after ``retry_after_s`` (reject-with-retry-after)."""
+
+    def __init__(self, retry_after_s: float) -> None:
+        super().__init__(
+            f"serving queue full; retry after {retry_after_s:.3f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class RequestTimeout(TimeoutError):
+    """The request's deadline passed while it waited in the queue."""
+
+
+class SchedulerStopped(RuntimeError):
+    """The scheduler shut down before this request was dispatched."""
+
+
+@dataclasses.dataclass
+class ServedResult:
+    """What a resolved request future carries."""
+
+    actions: np.ndarray
+    model_step: int  # checkpoint step of the params that answered
+    latency_s: float  # enqueue -> result
+
+
+@dataclasses.dataclass
+class _Request:
+    obs: np.ndarray
+    deterministic: bool
+    future: Future
+    enqueued: float
+    timeout_s: Optional[float]
+
+    def expired(self, now: float) -> bool:
+        return self.timeout_s is not None and (
+            now - self.enqueued > self.timeout_s
+        )
+
+
+class MicroBatchScheduler:
+    """Deadline-window micro-batching over a :class:`BucketedPolicyEngine`.
+
+    Args:
+      engine: the compiled act functions.
+      registry: optional ``ModelRegistry``; ``None`` serves the engine's
+        wrapped policy params forever (step reported as 0).
+      max_queue: bound on queued *requests*; the backpressure knob.
+      window_ms: coalescing deadline. 0 disables coalescing (each request
+        dispatches alone — the latency-over-throughput corner).
+      default_timeout_s: per-request deadline when ``submit`` gets none.
+      logger: optional ``utils.logging.MetricsLogger``; a metrics record
+        is emitted every ``emit_every`` batches.
+    """
+
+    def __init__(
+        self,
+        engine: BucketedPolicyEngine,
+        registry: Any = None,
+        max_queue: int = 256,
+        window_ms: float = 2.0,
+        default_timeout_s: float = 10.0,
+        metrics: Optional[ServingMetrics] = None,
+        logger: Any = None,
+        emit_every: int = 100,
+    ) -> None:
+        self.engine = engine
+        self.registry = registry
+        self.window_s = window_ms / 1e3
+        self.default_timeout_s = default_timeout_s
+        self.metrics = metrics or ServingMetrics()
+        self.logger = logger
+        self.emit_every = emit_every
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- client side -----------------------------------------------------
+
+    def submit(
+        self,
+        obs: np.ndarray,
+        deterministic: bool = True,
+        timeout_s: Optional[float] = None,
+    ) -> Future:
+        """Enqueue one request of ``(n, *row_shape)`` observation rows.
+        Returns a future resolving to :class:`ServedResult`. Raises
+        :class:`BackpressureError` when the queue is full."""
+        if self._thread is None:
+            raise RuntimeError("scheduler not started (use start() / with)")
+        obs = np.asarray(obs, np.float32)
+        if obs.ndim < 2 or obs.shape[0] < 1:
+            raise ValueError(
+                f"obs must be (n >= 1, *row_shape), got shape {obs.shape}"
+            )
+        req = _Request(
+            obs=obs,
+            deterministic=bool(deterministic),
+            future=Future(),
+            enqueued=time.perf_counter(),
+            timeout_s=(
+                self.default_timeout_s if timeout_s is None else timeout_s
+            ),
+        )
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self.metrics.record_reject()
+            raise BackpressureError(self.retry_after_s()) from None
+        if self._stop.is_set():
+            # stop() may have drained the queue between our liveness
+            # check and the put — there is no worker left to take this
+            # request, so drain again ourselves (resolving the future,
+            # whether ours or another racing submitter's).
+            self._drain_stopped_queue()
+        self.metrics.record_submit(self._queue.qsize())
+        return req.future
+
+    def retry_after_s(self) -> float:
+        """Backoff hint: the window plus roughly how long the current
+        backlog takes to drain at the recent batch rate."""
+        backlog = self._queue.qsize()
+        return self.window_s + backlog * self.metrics.mean_batch_seconds()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "MicroBatchScheduler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="microbatch-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+        self._thread = None
+        # Fail anything still queued — no silent dropped futures.
+        self._drain_stopped_queue()
+
+    def _drain_stopped_queue(self) -> None:
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(
+                    SchedulerStopped("scheduler stopped before dispatch")
+                )
+
+    def __enter__(self) -> "MicroBatchScheduler":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- worker side -----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            rows = first.obs.shape[0]
+            deadline = time.perf_counter() + self.window_s
+            # Coalesce until the window closes or the top bucket is full
+            # (more rows than the top bucket would split into a second
+            # dispatch anyway — no latency win in waiting further).
+            while rows < self.engine.max_bucket:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(nxt)
+                rows += nxt.obs.shape[0]
+            try:
+                self._dispatch(batch)
+            except Exception as e:  # noqa: BLE001 — the worker must survive
+                # Backstop: _dispatch_group already contains engine
+                # errors, but nothing outside it may kill the worker —
+                # a dead worker wedges every future client forever.
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        now = time.perf_counter()
+        live: List[_Request] = []
+        expired = 0
+        for req in batch:
+            if req.expired(now):
+                req.future.set_exception(
+                    RequestTimeout(
+                        f"request waited {now - req.enqueued:.3f}s "
+                        f"(timeout {req.timeout_s:.3f}s)"
+                    )
+                )
+                expired += 1
+            else:
+                live.append(req)
+        if expired:
+            self.metrics.record_timeout(expired)
+        # Group by (deterministic, row shape): ``deterministic`` is
+        # per-batch (one traced scalar), and rows of different trailing
+        # shapes cannot share a concatenated buffer — one client sending
+        # odd-shaped observations must never fail another's request.
+        groups: dict = {}
+        for r in live:
+            groups.setdefault((r.deterministic, r.obs.shape[1:]), []).append(r)
+        for (flag, _), group in groups.items():
+            self._dispatch_group(group, flag)
+
+    def _dispatch_group(self, group: List[_Request], flag: bool) -> None:
+        if self.registry is not None:
+            nn_params, step = self.registry.active()
+        else:
+            nn_params, step = None, 0
+        sizes = [r.obs.shape[0] for r in group]
+        obs = (
+            group[0].obs
+            if len(group) == 1
+            else np.concatenate([r.obs for r in group], axis=0)
+        )
+        t0 = time.perf_counter()
+        try:
+            actions = self.engine.act(
+                obs, deterministic=flag, nn_params=nn_params
+            )
+        except Exception as e:  # noqa: BLE001 — fail the batch, not the server
+            for req in group:
+                req.future.set_exception(e)
+            return
+        done = time.perf_counter()
+        latencies = []
+        offset = 0
+        for req, n in zip(group, sizes):
+            latency = done - req.enqueued
+            latencies.append(latency)
+            req.future.set_result(
+                ServedResult(
+                    actions=actions[offset : offset + n],
+                    model_step=step,
+                    latency_s=latency,
+                )
+            )
+            offset += n
+        total = sum(sizes)
+        self.metrics.record_batch(
+            rows=total,
+            padded_rows=sum(self.engine.plan(total)),
+            batch_seconds=done - t0,
+            latencies_s=latencies,
+            queue_depth=self._queue.qsize(),
+        )
+        if (
+            self.logger is not None
+            and self.metrics.batches_total % self.emit_every == 0
+        ):
+            record = self.metrics.snapshot()
+            record["model_step"] = float(step)
+            if self.registry is not None:
+                record["model_swap_count"] = float(self.registry.swap_count)
+            self.logger.log(record, step=self.metrics.batches_total)

@@ -151,14 +151,93 @@ def restore_checkpoint_partial(
     state); this restores every template key present in the file and simply
     omits the rest, so a single-host checkpoint resumes multi-host and vice
     versa. Extra keys in the file are ignored.
+
+    Every restored leaf is validated against the template leaf's shape: a
+    checkpoint from a different architecture (other tower widths, another
+    policy class) raises a ``ValueError`` naming the offending leaf here,
+    at restore time — not a shape crash later inside a compiled train step
+    or serving act function.
     """
     raw = serialization.msgpack_restore(Path(path).read_bytes())
     assert isinstance(raw, dict), f"checkpoint at {path} is not a dict"
-    return {
-        k: serialization.from_state_dict(tmpl, raw[k])
-        for k, tmpl in template.items()
-        if k in raw
-    }
+    return restore_state_dict_partial(raw, template, origin=str(path))
+
+
+def restore_state_dict_partial(
+    raw: dict, template: dict, origin: str = "<state dict>"
+) -> dict:
+    """`restore_checkpoint_partial` over an already-parsed state dict
+    (the serving registry reads the file once for its header check and
+    restores from the same parse). Same intersection + leaf-shape
+    validation contract; ``origin`` names the source in errors."""
+    restored = {}
+    for key, tmpl in template.items():
+        if key not in raw:
+            continue
+        try:
+            value = serialization.from_state_dict(tmpl, raw[key])
+        except Exception as e:  # noqa: BLE001 — any flax restore failure
+            # flax raises on structural mismatch (missing/renamed nested
+            # keys as ValueError/KeyError, array-where-dict as
+            # AttributeError/TypeError — all of them a different
+            # architecture); add which file and key.
+            raise ValueError(
+                f"checkpoint {origin}: key {key!r} does not match the "
+                f"restore template (architecture mismatch?): {e!r}"
+            ) from e
+        _check_leaf_shapes(tmpl, value, origin, key)
+        restored[key] = value
+    return restored
+
+
+def _check_leaf_shapes(tmpl: Any, restored: Any, origin: str, key: str) -> None:
+    """Leaf-by-leaf shape (and, for array leaves, dtype) comparison of a
+    restored subtree against its template. ``from_state_dict`` copies
+    leaf values verbatim, so a same-structure checkpoint with different
+    layer widths — or same shapes at a drifted dtype — restores silently
+    and only explodes later inside jit (a dtype drift is worse than a
+    crash: it is a retrace, which a serving RetraceGuard turns into a
+    permanent failure). Catch both here with the leaf path in hand.
+    Dtype is compared only when BOTH leaves are arrays: scalar template
+    leaves like ``num_timesteps: 0`` legitimately restore as whatever
+    integer width the writer used."""
+    import jax
+    import numpy as np
+
+    t_leaves, t_def = jax.tree_util.tree_flatten_with_path(tmpl)
+    r_leaves, r_def = jax.tree_util.tree_flatten_with_path(restored)
+    if t_def != r_def:
+        # from_state_dict can hand back a DEEPER tree than the template
+        # (a dict where an array leaf belongs restores verbatim) — a
+        # plain leaf zip would silently pair across the drift.
+        raise ValueError(
+            f"checkpoint {origin}: key {key!r} tree structure does not "
+            f"match the restore template — architecture mismatch "
+            f"(template {t_def}, checkpoint {r_def})"
+        )
+    for (t_path, t_leaf), (_, r_leaf) in zip(t_leaves, r_leaves):
+        t_shape, r_shape = np.shape(t_leaf), np.shape(r_leaf)
+        problem = None
+        if t_shape != r_shape:
+            problem = f"shape {r_shape}, but the template expects {t_shape}"
+        else:
+            t_dtype = getattr(t_leaf, "dtype", None)
+            r_dtype = getattr(r_leaf, "dtype", None)
+            if (
+                t_dtype is not None
+                and r_dtype is not None
+                and t_dtype != r_dtype
+            ):
+                problem = (
+                    f"dtype {r_dtype}, but the template expects {t_dtype}"
+                )
+        if problem:
+            leaf_name = jax.tree_util.keystr(t_path)
+            raise ValueError(
+                f"checkpoint {origin}: key {key!r} leaf {leaf_name} has "
+                f"{problem} — architecture mismatch (refusing to restore "
+                "an incompatible tree)"
+            )
 
 
 def broadcast_restore(log_dir: str | Path, template: dict) -> Optional[dict]:

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Serve a trained policy from its checkpoint directory.
+
+Usage:
+    # one-shot smoke benchmark against the newest checkpoint (1 JSON line)
+    python scripts/serve_policy.py logs/run1 --smoke
+
+    # long-running server: hot-reloads new checkpoints as training writes
+    # them, emits serving metrics to {log_dir}/serving/metrics.jsonl
+    python scripts/serve_policy.py logs/run1 --watch
+
+    # no checkpoint yet? serve a freshly initialized policy
+    python scripts/serve_policy.py --init-policy MLPActorCritic --obs-dim 8 --smoke
+
+The server is the in-process stack from
+``marl_distributedformation_tpu.serving`` (bucketed compiled engine,
+micro-batching scheduler, hot-reload registry — docs/serving.md); this
+CLI wires it to a checkpoint directory and drives it with a synthetic
+mixed-size load (``--smoke``) or leaves it serving + watching
+(``--watch``, the mode a real frontend would embed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Some containers (this repo's test image included) import jax at
+    # interpreter start via sitecustomize, which swallows JAX_PLATFORMS
+    # from the environment — re-assert the requested platform the way
+    # tests/conftest.py does, so `JAX_PLATFORMS=cpu serve_policy.py`
+    # means what it says instead of silently serving over a tunneled TPU.
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def _infer_row_shape(policy) -> tuple:
+    """Feature shape of one request row. Per-formation policies
+    (CTDE/GNN) take whole ``(num_agents, obs_dim)`` formations as rows
+    and their tower widths are post-embedding — inference from the
+    kernel is wrong there, so both dims must be passed explicitly. For
+    flat per-agent policies the first tower layer's kernel records the
+    obs width (the same inference compat.policy.infer_hidden does for
+    tower widths)."""
+    if getattr(policy.model, "per_formation", False):
+        raise SystemExit(
+            f"policy {type(policy.model).__name__} serves whole "
+            "formations: pass --obs-dim AND --agents to size a request "
+            "row (row shape = (agents, obs_dim))"
+        )
+    inner = policy.params.get("params", {})
+    kernel = inner.get("pi_0", {}).get("kernel")
+    if kernel is None:
+        raise SystemExit(
+            "cannot infer --obs-dim from this checkpoint "
+            f"(policy {type(policy.model).__name__}); pass --obs-dim"
+        )
+    import numpy as np
+
+    return (int(np.shape(kernel)[0]),)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "log_dir",
+        nargs="?",
+        help="checkpoint directory (logs/{name}) to serve and watch",
+    )
+    parser.add_argument(
+        "--init-policy",
+        help="serve a freshly initialized policy of this class instead of "
+        "a checkpoint (requires --obs-dim)",
+    )
+    parser.add_argument("--obs-dim", type=int, help="request row width")
+    parser.add_argument(
+        "--agents",
+        type=int,
+        help="agents per formation — required for per-formation policies "
+        "(CTDE/GNN), whose request rows are (agents, obs_dim)",
+    )
+    parser.add_argument(
+        "--buckets",
+        default="1,8,64,512",
+        help="comma-separated batch-shape ladder (default 1,8,64,512)",
+    )
+    parser.add_argument(
+        "--window-ms", type=float, default=2.0, help="coalescing window"
+    )
+    parser.add_argument(
+        "--queue", type=int, default=256, help="request queue bound"
+    )
+    parser.add_argument(
+        "--poll-s", type=float, default=2.0, help="checkpoint poll cadence"
+    )
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="run the mixed-size smoke benchmark and print one JSON line",
+    )
+    parser.add_argument(
+        "--duration", type=float, default=3.0, help="smoke duration (s)"
+    )
+    parser.add_argument(
+        "--clients", type=int, default=4, help="smoke client threads"
+    )
+    parser.add_argument(
+        "--stochastic",
+        action="store_true",
+        help="sample actions instead of the deterministic mode",
+    )
+    parser.add_argument(
+        "--watch",
+        action="store_true",
+        help="keep serving + hot-reloading until interrupted",
+    )
+    args = parser.parse_args(argv)
+
+    from marl_distributedformation_tpu.serving import (
+        BucketedPolicyEngine,
+        MicroBatchScheduler,
+        ModelRegistry,
+        run_smoke_benchmark,
+    )
+
+    registry = None
+    if args.init_policy:
+        if args.obs_dim is None:
+            raise SystemExit("--init-policy requires --obs-dim")
+        import jax
+        import jax.numpy as jnp
+
+        from marl_distributedformation_tpu.compat.policy import (
+            POLICY_REGISTRY,
+            LoadedPolicy,
+        )
+
+        if args.init_policy not in POLICY_REGISTRY:
+            raise SystemExit(
+                f"unknown policy {args.init_policy!r}; known: "
+                f"{sorted(POLICY_REGISTRY)}"
+            )
+        model = POLICY_REGISTRY[args.init_policy](act_dim=2)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, args.obs_dim))
+        )
+        policy = LoadedPolicy(dict(variables), policy=args.init_policy)
+    elif args.log_dir:
+        registry = ModelRegistry(
+            args.log_dir, poll_interval_s=args.poll_s
+        )
+        policy = registry.policy
+        print(
+            f"[serve] serving {type(policy.model).__name__} from "
+            f"{args.log_dir} at step {registry.active_step}",
+            file=sys.stderr,
+        )
+    else:
+        raise SystemExit("need a log_dir or --init-policy (see --help)")
+
+    if args.obs_dim:
+        row_shape = (
+            (args.agents, args.obs_dim) if args.agents else (args.obs_dim,)
+        )
+    else:
+        row_shape = _infer_row_shape(policy)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine = BucketedPolicyEngine(policy, buckets=buckets)
+
+    logger = None
+    if args.log_dir:
+        from marl_distributedformation_tpu.utils.logging import MetricsLogger
+
+        logger = MetricsLogger(
+            Path(args.log_dir) / "serving", run_name="serving"
+        )
+
+    scheduler = MicroBatchScheduler(
+        engine,
+        registry=registry,
+        max_queue=args.queue,
+        window_ms=args.window_ms,
+        logger=logger,
+    )
+    if registry is not None:
+        registry.start()
+    try:
+        with scheduler:
+            if args.smoke or not args.watch:
+                report = run_smoke_benchmark(
+                    scheduler,
+                    row_shape=row_shape,
+                    duration_s=args.duration,
+                    num_clients=args.clients,
+                    deterministic=not args.stochastic,
+                    registry=registry,
+                )
+                report["buckets"] = ",".join(str(b) for b in buckets)
+                print(json.dumps(report), flush=True)
+                if report["client_requests_ok"] == 0:
+                    # A smoke run that served nothing is a failure, not
+                    # a report (e.g. a row shape the model rejects).
+                    print(
+                        "[serve] smoke served 0 requests — failing",
+                        file=sys.stderr,
+                    )
+                    return 1
+            else:
+                print(
+                    "[serve] watching for checkpoints; Ctrl-C to stop",
+                    file=sys.stderr,
+                )
+                while True:
+                    time.sleep(10.0)
+                    snap = scheduler.metrics.snapshot()
+                    print(
+                        f"[serve] step={registry.active_step if registry else 0} "
+                        f"requests={snap['requests']:.0f} "
+                        f"occupancy={snap['batch_occupancy_pct']:.1f}% "
+                        f"p95={snap['latency_p95_ms']:.1f}ms",
+                        file=sys.stderr,
+                    )
+    except KeyboardInterrupt:
+        print("[serve] interrupted; shutting down", file=sys.stderr)
+    finally:
+        if registry is not None:
+            registry.stop()
+        if logger is not None:
+            logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,61 @@
+"""Adam-amplification tolerance budget for sharding/multiprocess parity
+gates (ROADMAP "Open items" analysis, made explicit).
+
+The facts the budget is built from:
+
+1. **Base noise.** A batched-one-device XLA program and its
+   per-device-sharded lowering reduce sums in different orders; measured
+   on jax 0.4.37/CPU, a single minibatch gradient matches between the
+   two to ~3e-8 relative — pure fp reduction-order noise, not a bug.
+2. **Adam amplification.** Adam's update is
+   ``lr * m_hat / (sqrt(v_hat) + eps)`` — *normalized*: the update
+   magnitude is ~``lr`` per parameter regardless of gradient scale. A
+   perturbation of ANY size (even 3e-8) can flip the sign of a
+   near-zero ``m_hat`` component, so two runs from the same init can
+   legitimately drift apart by up to ``2 * lr`` per parameter per
+   update, compounding through the on-policy trajectory.
+3. **Measured headroom.** In this container the observed divergence
+   after U updates is ~``0.3 * lr * U`` (test_sweep: 2.9e-3 at
+   ``lr*U = 8e-3``; test_hetero_sweep: 9.7e-3 at ``lr*U = 3.2e-2``).
+
+So the budget for parameters is ``atol = lr * U`` (3x above observed
+noise, and the theoretical half-bound), with ``rtol = 0`` — Adam steps
+are absolute-scaled, so an absolute tolerance is the principled unit.
+A flat ``rtol=1e-4`` (the old gate) was wrong in BOTH directions: it
+failed on legitimate fp noise for near-zero parameters and would have
+passed garbage for large ones.
+
+Scalar training metrics (reward/loss) feel a parameter perturbation
+through the whole rollout; their *relative* divergence tracks
+``lr * U`` with a trajectory sensitivity factor — calibrated at 30x
+(observed sweep reward rel-divergence is ~1e-3 at ``lr*U = 8e-3``,
+i.e. factor ~0.1; 30 covers episode-boundary discontinuities, where a
+near-done formation can flip which side of the reset a step lands on).
+"""
+
+# Measured single-minibatch sharded-vs-unsharded gradient mismatch:
+# fp reduction-order noise between XLA lowerings (jax 0.4.37, CPU).
+FP_REDUCTION_NOISE = 3e-8
+
+
+def updates_per_run(ppo, rows_per_iter: int, iterations: int) -> int:
+    """Optimizer steps a run of ``iterations`` trainer iterations takes:
+    ``n_epochs * (usable minibatches)`` per iteration, mirroring
+    algo.ppo's clamp-and-drop-remainder minibatching."""
+    batch = min(ppo.batch_size, rows_per_iter)
+    return iterations * ppo.n_epochs * (rows_per_iter // batch)
+
+
+def adam_parity_atol(lr: float, num_updates: int) -> float:
+    """Parameter-space budget: up to ~lr of normalized-update drift per
+    Adam step once fp noise breaks the tie, summed over updates. Use
+    with ``rtol=0`` — see the module docstring for the derivation."""
+    return FP_REDUCTION_NOISE + float(lr) * num_updates
+
+
+def trajectory_rtol(
+    lr: float, num_updates: int, sensitivity: float = 30.0
+) -> float:
+    """Relative budget for scalar rollout metrics (reward, loss) of two
+    runs whose parameters diverged within ``adam_parity_atol``."""
+    return sensitivity * float(lr) * num_updates

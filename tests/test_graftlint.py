@@ -329,6 +329,46 @@ FIXTURES = [
             return x
         """,
     ),
+    (
+        "scan-carry-weak-type",
+        """
+        import jax
+        from jax import lax
+
+        def rollout(body, x, xs):
+            # 0.0 is a weak-typed Python scalar: the body's arithmetic
+            # promotes it and the carry comes back a different aval.
+            return lax.scan(body, (x, 0.0), xs)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        from jax import lax
+
+        def rollout(body, x, xs):
+            carry = (x, jnp.asarray(0.0, jnp.float32))
+            out = lax.scan(body, carry, xs)
+            # literals inside constructors are strong-typed: fine
+            return lax.scan(body, (x, jnp.zeros((4,))), xs), out
+        """,
+    ),
+    (
+        "scan-carry-weak-type",
+        """
+        import jax
+
+        def count(body, xs):
+            # keyword init + unary sign both reach the literal
+            return jax.lax.scan(body, init=-1, xs=xs)
+        """,
+        """
+        import jax, jax.numpy as jnp
+
+        def count(body, xs, n0):
+            # int dict KEYS are pytree structure, not carry leaves
+            out = jax.lax.scan(body, init={0: n0, 1: n0}, xs=xs)
+            return jax.lax.scan(body, init=n0, xs=xs), out
+        """,
+    ),
 ]
 
 
@@ -360,6 +400,17 @@ def test_package_is_clean_at_default_severity():
 
     violations = lint_paths([PACKAGE], load_config(REPO), root=REPO)
     assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_package_scan_covers_serving():
+    """The zero-violation pin must include the serving/ subsystem (a
+    future exclude entry or package move cannot silently drop it)."""
+    from marl_distributedformation_tpu.analysis import load_config
+    from marl_distributedformation_tpu.analysis.linter import iter_python_files
+
+    files = list(iter_python_files([PACKAGE], load_config(REPO), root=REPO))
+    served = [f for f in files if "serving" in f.parts]
+    assert len(served) >= 6, f"serving/ missing from the lint scan: {files}"
 
 
 # ---------------------------------------------------------------------------

@@ -17,9 +17,46 @@ import subprocess
 import sys
 from pathlib import Path
 
+import numpy as np
 import pytest
 
+from adam_budget import trajectory_rtol
+
 REPO = Path(__file__).resolve().parent.parent
+
+# Per-process SPMD programs may lower reductions in different orders
+# (the ~3e-8 fp noise of the sharding parity tests), which Adam
+# amplifies to O(lr) per update — so cross-process scalar gates use the
+# explicit budget from tests/adam_budget.py instead of exact string
+# equality of formatted floats. lr is the PPO default (1e-3) in every
+# worker below; U is counted per worker at its gate.
+_LR = 1e-3
+
+
+def _parse_metric(outs, tag):
+    """The '{tag}=<float>' values printed by both worker processes."""
+    vals = [
+        float(line.split(f"{tag}=")[1].split()[0])
+        for out in outs
+        for line in out.splitlines()
+        if f"{tag}=" in line
+    ]
+    assert len(vals) == 2, f"expected {tag} from both processes: {vals}"
+    return vals
+
+
+def _skip_if_backend_cannot_multiprocess(outs):
+    """Some jaxlib builds' CPU backend refuses multi-process collectives
+    outright ('Multiprocess computations aren't implemented on the CPU
+    backend') — then this test is unrunnable in the container, which is
+    an environmental limitation, not a code failure."""
+    if any(
+        "Multiprocess computations aren't implemented" in out for out in outs
+    ):
+        pytest.skip(
+            "this jaxlib's CPU backend lacks multi-process collectives; "
+            "the cross-process contract needs real multi-host hardware"
+        )
 
 WORKER = """
 import sys
@@ -131,20 +168,20 @@ def test_two_process_training_and_broadcast_resume(tmp_path):
                 q.kill()
             raise
         outs.append(out)
+    _skip_if_backend_cannot_multiprocess(outs)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"TRAINED p{pid}" in out, out
         assert f"RESUMED p{pid}" in out, out
-    # The resume restored identical learner state everywhere: both processes
-    # must report the SAME post-resume loss (they run one more globally
-    # synchronized iteration).
-    losses = {
-        line.split("loss=")[1]
-        for out in outs
-        for line in out.splitlines()
-        if "RESUMED" in line
-    }
-    assert len(losses) == 1, f"post-resume losses diverged: {losses}"
+    # The resume restored identical learner state everywhere: the
+    # post-resume loss must agree across processes within the Adam
+    # budget (the compared value sits behind 3 optimizer updates:
+    # 2 pre-save iterations + 1 post-resume, 1 minibatch/epoch each).
+    # atol floors the gate at the worker's %.4f print quantization.
+    losses = _parse_metric(outs, "loss")
+    np.testing.assert_allclose(
+        losses[0], losses[1], rtol=trajectory_rtol(_LR, 3), atol=2e-4
+    )
     # Exactly one checkpoint series on disk, written by the coordinator.
     files = sorted(log_dir.glob("rl_model_*_steps.msgpack"))
     assert files, "coordinator wrote no checkpoints"
@@ -258,19 +295,19 @@ def test_two_process_population_sweep(tmp_path):
                 q.kill()
             raise
         outs.append(out)
+    _skip_if_backend_cannot_multiprocess(outs)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"TRAINED p{pid}" in out, out
         assert f"RESUMED p{pid}" in out, out
     # The post-resume iteration is globally synchronized: member 0's
-    # reward must agree across processes.
-    rewards = {
-        line.split("reward0=")[1]
-        for out in outs
-        for line in out.splitlines()
-        if "RESUMED" in line
-    }
-    assert len(rewards) == 1, f"post-resume member rewards diverged: {rewards}"
+    # reward must agree across processes within the Adam budget (2
+    # optimizer updates behind the compared value; member 0 trains at
+    # the 1e-3 rate of the sweep's learning_rates).
+    rewards = _parse_metric(outs, "reward0")
+    np.testing.assert_allclose(
+        rewards[0], rewards[1], rtol=trajectory_rtol(_LR, 2), atol=2e-6
+    )
     # Coordinator wrote per-member checkpoints, the population state, and
     # the ranking summary.
     for i in range(4):
@@ -393,15 +430,16 @@ def test_two_process_hetero_curriculum(tmp_path):
                 q.kill()
             raise
         outs.append(out)
+    _skip_if_backend_cannot_multiprocess(outs)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"TRAINED p{pid}" in out, out
         assert f"RESUMED p{pid}" in out, out
-    losses = {
-        line.split("loss=")[1]
-        for out in outs
-        for line in out.splitlines()
-        if "RESUMED" in line
-    }
-    assert len(losses) == 1, f"post-resume losses diverged: {losses}"
+    # Post-resume loss across processes, within the Adam budget (the
+    # compared value sits behind 5 optimizer updates: 1 + 2 across the
+    # two curriculum stages, then 2 more in the re-entered last stage).
+    losses = _parse_metric(outs, "loss")
+    np.testing.assert_allclose(
+        losses[0], losses[1], rtol=trajectory_rtol(_LR, 5), atol=2e-6
+    )
     assert list(log_dir.glob("rl_model_*_steps.msgpack"))

@@ -86,7 +86,16 @@ def test_member_matches_single_trainer(tmp_path):
 
 @pytest.mark.slow
 def test_seed_axis_sharding_matches_unsharded(tmp_path):
-    """mesh={dp: 4} shards the population with zero numeric effect."""
+    """mesh={dp: 4} shards the population with no effect beyond fp
+    reduction-order noise.
+
+    Tolerances are the explicit Adam-amplification budget
+    (tests/adam_budget.py): the one-device and dp-sharded XLA lowerings
+    reduce in different orders (~3e-8 per minibatch gradient), and
+    Adam's normalized update amplifies any tie-break to O(lr) per
+    optimizer step — a flat rtol can never gate this correctly."""
+    from adam_budget import adam_parity_atol, trajectory_rtol, updates_per_run
+
     params = EnvParams(num_agents=3)
     plain = SweepTrainer(params, ppo=PPO, config=_cfg(tmp_path), num_seeds=4)
     sharded = SweepTrainer(
@@ -96,16 +105,22 @@ def test_seed_axis_sharding_matches_unsharded(tmp_path):
         num_seeds=4,
         mesh=make_mesh({"dp": 4}),
     )
-    for _ in range(2):
+    iterations = 2
+    for _ in range(iterations):
         m_plain = plain.run_iteration()
         m_shard = sharded.run_iteration()
+    # Per-member rollout rows: n_steps * num_formations * num_agents.
+    updates = updates_per_run(PPO, PPO.n_steps * 4 * 3, iterations)
     _leaves_allclose(
-        plain.train_state.params, sharded.train_state.params, rtol=1e-4
+        plain.train_state.params,
+        sharded.train_state.params,
+        rtol=0,
+        atol=adam_parity_atol(PPO.learning_rate, updates),
     )
     np.testing.assert_allclose(
         np.asarray(m_plain["reward"]),
         np.asarray(m_shard["reward"]),
-        rtol=1e-4,
+        rtol=trajectory_rtol(PPO.learning_rate, updates),
     )
 
 
