@@ -19,6 +19,9 @@ Measures, inside one process and one JSON line:
 - ``knn_big_env_steps_per_sec``: the N=1024 swarm past the fused kernel's
   VMEM cliff (chunked-streaming kernel on TPU, XLA elsewhere; the
   ``knn_big_impl`` field records which ran).
+- ``scenario_env_steps_per_sec``: env stepping through the 3-layer
+  "storm" disturbance stack (scenarios/) — the scenario engine's wrapper
+  overhead vs the clean headline (``scenario_overhead_pct``).
 
 Hardened against the flaky axon tunnel (round-1 failure mode: the first
 device op hung for minutes and the round recorded nothing):
@@ -35,7 +38,7 @@ device op hung for minutes and the round recorded nothing):
 Env-var knobs: BENCH_M, BENCH_N, BENCH_CHUNK, BENCH_TRAIN_M, BENCH_KNN_M,
 BENCH_KNN_BIG_M, BENCH_KNN_BIG_N, BENCH_BUDGET_S, BENCH_PROBE_TIMEOUT_S,
 BENCH_FORCE_CPU=1, BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1,
-BENCH_SKIP_KNN_BIG=1.
+BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -134,15 +137,54 @@ def make_runner(params, m: int, chunk: int):
     return run_chunk
 
 
-def _time_env_phase(params, m: int, chunk: int, deadline: float) -> float:
+def make_scenario_runner(params, m: int, chunk: int, sp):
+    """Scenario-stacked twin of ``make_runner``: the same random-policy
+    chunk through ``scenarios.scenario_step_batch`` with the disturbance
+    params as a traced argument (measures the wrapper's true overhead —
+    every layer's math is in the program, magnitudes are data)."""
+    import jax
+
+    from marl_distributedformation_tpu.scenarios import scenario_step_batch
+
+    @jax.jit
+    def run_chunk(state, key, sp):
+        def body(carry, _):
+            state, key = carry
+            key, k_act = jax.random.split(key)
+            actions = jax.random.uniform(
+                k_act, (m, params.num_agents, 2), minval=-1.0, maxval=1.0
+            )
+            state, tr = scenario_step_batch(
+                state, params.max_speed * actions, sp, params
+            )
+            return (state, key), tr.reward.mean()
+
+        (state, key), rewards = jax.lax.scan(
+            body, (state, key), None, length=chunk
+        )
+        return state, key, rewards.mean()
+
+    def run(state, key):
+        return run_chunk(state, key, sp)
+
+    return run
+
+
+def _time_env_phase(
+    params, m: int, chunk: int, deadline: float, scenario=None
+) -> float:
     """Adaptive timing: warm up (compile + 1 exec), then run timed chunks
-    until MIN_TIMED_S of signal or the deadline. Returns formation-steps/s."""
+    until MIN_TIMED_S of signal or the deadline. Returns formation-steps/s.
+    ``scenario`` (ScenarioParams) times the disturbance-stacked step."""
     import jax
 
     from marl_distributedformation_tpu.env.formation import reset_batch
 
     state = reset_batch(jax.random.PRNGKey(0), params, m)
-    run_chunk = make_runner(params, m, chunk)
+    if scenario is None:
+        run_chunk = make_runner(params, m, chunk)
+    else:
+        run_chunk = make_scenario_runner(params, m, chunk, scenario)
 
     state, key, r = run_chunk(state, jax.random.PRNGKey(1))
     float(r)  # hard host sync: block_until_ready under-reports on axon
@@ -421,6 +463,48 @@ def main() -> None:
                 )
             except Exception as e:  # noqa: BLE001 — degrade, don't die
                 notes.append(f"env-max phase failed: {e!r}"[:200])
+
+        # Phase 1c — scenario engine overhead: the same env stepping
+        # through the 3-layer "storm" disturbance stack (wind + actuator
+        # noise + sensor noise, scenarios/) at severity 1. The wrapper
+        # keeps every layer's math in the compiled program with
+        # magnitudes as traced data, so this rate vs the headline is the
+        # full price of scenario-readiness — recorded so the perf
+        # trajectory catches a regression in the stack.
+        if (
+            os.environ.get("BENCH_SKIP_SCENARIO") != "1"
+            and time.time() < deadline - 30
+        ):
+            try:
+                import jax.numpy as jnp
+
+                from marl_distributedformation_tpu.scenarios import (
+                    broadcast_params,
+                    get_scenario,
+                )
+
+                storm = broadcast_params(
+                    get_scenario("storm").build(jnp.float32(1.0)), M
+                )
+                rate_scen = _time_env_phase(
+                    EnvParams(num_agents=N), M, CHUNK, deadline,
+                    scenario=storm,
+                )
+                result["scenario_env_steps_per_sec"] = round(rate_scen, 1)
+                result["scenario_stack"] = "storm@1.0"
+                if rate:
+                    result["scenario_overhead_pct"] = round(
+                        max(0.0, (1.0 - rate_scen / rate) * 100.0), 1
+                    )
+                print(
+                    f"[bench] scenario (storm, 3 layers): "
+                    f"{rate_scen:,.0f} formation-steps/s "
+                    f"({result.get('scenario_overhead_pct', 0.0):.1f}% "
+                    "overhead vs clean)",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                notes.append(f"scenario phase failed: {e!r}"[:200])
 
         # Phase 2 — full PPO training iteration, at BOTH hyperparameter
         # points: the reference-parity config (SB3 batch_size=64 — tiny

@@ -11,6 +11,13 @@ Usage:
     python evaluate.py name=myrun                  # latest checkpoint of run
     python evaluate.py checkpoint=logs/x/rl_model_200_steps.ckpt
     python evaluate.py name=myrun eval_formations=1024 eval_seed=7
+    python evaluate.py name=myrun scenario=wind scenario_severity=0.5
+                                                   # robustness: evaluate
+                                                   # under a disturbance
+                                                   # scenario (scenarios/)
+
+Unknown override keys and unknown scenario names fail fast with the valid
+entries — a typo must never silently evaluate the clean default.
 """
 
 from __future__ import annotations
@@ -31,7 +38,52 @@ from marl_distributedformation_tpu.utils import (
     load_config,
     repo_root,
     setup_platform,
+    validate_override_keys,
 )
+
+# Keys meaningful to this entry point beyond the YAML config defaults.
+EVAL_KEYS = (
+    "checkpoint",
+    "eval_formations",
+    "eval_seed",
+    "eval_deterministic",
+    "scenario",
+)
+
+
+def _scenario_params(cfg, overrides):
+    """Resolve ``scenario=NAME`` (+ ``scenario_severity``) to traced
+    ScenarioParams — unknown names exit naming the registry entries.
+
+    Two near-miss spellings that would otherwise pass key validation
+    (both are real YAML keys) and silently evaluate the CLEAN env are
+    rejected explicitly: the plural training key ``scenarios=``, and a
+    ``scenario_severity=`` override with no ``scenario=`` to apply it to.
+    """
+    name = cfg.get("scenario")
+    override_keys = {
+        o.split("=", 1)[0] for o in overrides if "=" in o
+    }
+    if "scenarios" in override_keys:
+        raise SystemExit(
+            "evaluate.py takes the SINGULAR scenario=<name> (scenarios= "
+            "is the train.py domain-randomization key and would be "
+            "ignored here); e.g. scenario=wind scenario_severity=0.5"
+        )
+    if not name:
+        if "scenario_severity" in override_keys:
+            raise SystemExit(
+                "scenario_severity=... was given without scenario=<name> "
+                "— it would silently apply to nothing; add scenario=<name>"
+            )
+        return None, None, None
+    from marl_distributedformation_tpu.scenarios import scenario_params_for
+
+    severity = float(cfg.get("scenario_severity", 0.5) or 0.0)
+    try:
+        return scenario_params_for(str(name), severity), str(name), severity
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
 
 
 def _resolved_backend() -> dict:
@@ -51,11 +103,17 @@ def _resolved_backend() -> dict:
 
 
 def main(argv=None) -> dict:
-    cfg = load_config(sys.argv[1:] if argv is None else argv)
+    overrides = sys.argv[1:] if argv is None else argv
+    # Fail fast on mistyped keys: this entry point has no config snapshot
+    # to surface a typo, and an ignored key means evaluating the wrong
+    # thing (e.g. the clean env instead of the requested scenario).
+    validate_override_keys(overrides, extra_keys=EVAL_KEYS)
+    cfg = load_config(overrides)
     setup_platform(cfg.get("platform"))
     params = env_params_from_config(cfg)
     m = int(cfg.get("eval_formations", 1024))
     seed = int(cfg.get("eval_seed", 1234))
+    sp, scenario_name, severity = _scenario_params(cfg, overrides)
 
     # eval_deterministic=false evaluates the policy as it behaves during
     # training (actions sampled from its Gaussian) — SB3's
@@ -82,7 +140,11 @@ def main(argv=None) -> dict:
             # Sweep run (train/sweep.py): rank EVERY member by held-out
             # evaluation on identical initial states — more principled
             # than sweep_summary.json's training-reward ranking.
-            return eval_sweep(member_dirs, params, m, seed, det)
+            return eval_sweep(
+                member_dirs, params, m, seed, det,
+                scenario_params=sp, scenario=scenario_name,
+                severity=severity,
+            )
         ckpt = latest_checkpoint(log_dir)
         if ckpt is None:
             raise SystemExit(
@@ -91,9 +153,15 @@ def main(argv=None) -> dict:
             )
 
     rows = {
-        "policy": evaluate_checkpoint(str(ckpt), params, m, seed, det),
-        "baseline": evaluate(baseline_act_fn(params), params, m, seed),
-        "zero": evaluate(zero_act_fn(), params, m, seed),
+        "policy": evaluate_checkpoint(
+            str(ckpt), params, m, seed, det, scenario_params=sp
+        ),
+        "baseline": evaluate(
+            baseline_act_fn(params), params, m, seed, scenario_params=sp
+        ),
+        "zero": evaluate(
+            zero_act_fn(), params, m, seed, scenario_params=sp
+        ),
     }
 
     cols = [
@@ -106,6 +174,8 @@ def main(argv=None) -> dict:
     print(f"[eval] checkpoint: {ckpt}")
     print(f"[eval] M={m} formations x N={params.num_agents} agents, "
           f"seed={seed}, full episodes")
+    if scenario_name:
+        print(f"[eval] scenario={scenario_name} severity={severity:g}")
     header = " | ".join(f"{c:>26}" for c in cols)
     print(f"{'':<{name_w}} | {header}")
     for name, r in rows.items():
@@ -118,6 +188,11 @@ def main(argv=None) -> dict:
         "num_agents": params.num_agents,
         "seed": seed,
         "eval_deterministic": det,
+        **(
+            {"scenario": scenario_name, "scenario_severity": severity}
+            if scenario_name
+            else {}
+        ),
         **{f"{name}_{c}": r[c] for name, r in rows.items() for c in cols},
         "beats_baseline": bool(
             rows["policy"]["episode_return_per_agent"]
@@ -130,7 +205,8 @@ def main(argv=None) -> dict:
 
 
 def eval_sweep(
-    member_dirs, params, m: int, seed: int, deterministic: bool = True
+    member_dirs, params, m: int, seed: int, deterministic: bool = True,
+    scenario_params=None, scenario=None, severity=None,
 ) -> dict:
     """Evaluate every sweep member's latest checkpoint plus the baseline
     and zero policies, all on the same initial states; print a ranked
@@ -142,12 +218,18 @@ def eval_sweep(
             print(f"[eval] {d.name}: no checkpoint, skipping")
             continue
         rows[d.name] = evaluate_checkpoint(
-            str(ckpt), params, m, seed, deterministic
+            str(ckpt), params, m, seed, deterministic,
+            scenario_params=scenario_params,
         )
     if not rows:
         raise SystemExit("no member checkpoints found under seed*/")
-    rows["baseline"] = evaluate(baseline_act_fn(params), params, m, seed)
-    rows["zero"] = evaluate(zero_act_fn(), params, m, seed)
+    rows["baseline"] = evaluate(
+        baseline_act_fn(params), params, m, seed,
+        scenario_params=scenario_params,
+    )
+    rows["zero"] = evaluate(
+        zero_act_fn(), params, m, seed, scenario_params=scenario_params
+    )
 
     key = "episode_return_per_agent"
     ranked = sorted(rows, key=lambda n: rows[n][key], reverse=True)
@@ -168,6 +250,11 @@ def eval_sweep(
         "num_agents": params.num_agents,
         "seed": seed,
         "eval_deterministic": deterministic,
+        **(
+            {"scenario": scenario, "scenario_severity": severity}
+            if scenario
+            else {}
+        ),
         "member_returns": {n: rows[n][key] for n in members},
         "best_member": best,
         "best_return": rows[best][key],

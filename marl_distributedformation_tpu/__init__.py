@@ -15,6 +15,10 @@ Design: functional core, imperative shell.
                  over the agent axis via ``shard_map`` + ``ppermute``)
 - ``train``    — jitted end-to-end trainer, checkpointing, metrics
 - ``ops``      — Pallas TPU kernels and fused ops
+- ``scenarios``— compile-once disturbance & scenario engine (perturbation
+                 layers, ScenarioSpec registry, robustness eval matrix)
+- ``serving``  — compiled micro-batching policy inference
+- ``analysis`` — graftlint static rules + runtime tracing guards
 - ``compat``   — reference-workflow-compatible host-side adapters/frontends
 
 Reference layer map and parity contract: see SURVEY.md at the repo root.

@@ -20,6 +20,9 @@ from marl_distributedformation_tpu.analysis.rules.prng import PrngKeyReuse
 from marl_distributedformation_tpu.analysis.rules.scan_carry import (
     ScanCarryWeakType,
 )
+from marl_distributedformation_tpu.analysis.rules.vmap_axes import (
+    VmapInAxesArity,
+)
 
 RULES = (
     NumpyInJit(),
@@ -31,6 +34,7 @@ RULES = (
     MissingDonate(),
     PrintInJit(),
     ScanCarryWeakType(),
+    VmapInAxesArity(),
 )
 
 

@@ -1,0 +1,155 @@
+"""vmap-in-axes-arity: ``in_axes`` container length vs the mapped arity.
+
+``jax.vmap(f, in_axes=(0, None))`` promises the mapped function exactly
+two positional arguments. When the tuple's length disagrees with either
+the function's signature or the immediate call site, JAX raises only at
+*trace* time — which for a library helper can be arbitrarily far from
+the mistake, inside someone else's jit, with the axes spec long out of
+view. The classic authoring bug is editing a function's signature (or
+the call) and forgetting the axes tuple.
+
+Two checks, both purely static and deliberately conservative (only
+top-level tuple/list ``in_axes`` literals; only ``Name``/``lambda``
+targets resolvable in the same module; skipped entirely for wrapped
+targets like ``functools.partial`` where the effective arity is not
+syntactic):
+
+1. signature: a resolvable target must be able to accept exactly
+   ``len(in_axes)`` positional args (``required <= len <= total``,
+   ``*args`` accepts anything);
+2. call site: ``jax.vmap(f, in_axes=...)(a, b, c)`` must pass exactly
+   ``len(in_axes)`` positional args (no starred/keyword args — those
+   make the count non-syntactic and are skipped).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+_VMAP_NAMES = frozenset({"jax.vmap", "vmap"})
+
+
+def _in_axes_literal(node: ast.Call) -> Optional[ast.AST]:
+    """The ``in_axes`` expression when given as a top-level tuple/list
+    literal, else None (ints, Names, nested pytrees: out of scope)."""
+    expr: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        expr = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "in_axes":
+            expr = kw.value
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return expr
+    return None
+
+
+def _rebound_names(ctx: ModuleContext) -> frozenset:
+    """Names that are assignment targets or function parameters anywhere
+    in the module — a def with such a name may be shadowed or rebound
+    (``f = functools.partial(f, ...)``), so its syntactic arity cannot
+    be trusted. Computed once per module and cached on the context."""
+    cached = getattr(ctx, "_vmap_rebound_names", None)
+    if cached is not None:
+        return cached
+    names = set()
+    for node in ast.walk(ctx.tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+            targets = [node.target]
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            a = node.args
+            names.update(
+                arg.arg
+                for arg in (
+                    *a.posonlyargs, *a.args, *a.kwonlyargs,
+                    *filter(None, (a.vararg, a.kwarg)),
+                )
+            )
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+    result = frozenset(names)
+    ctx._vmap_rebound_names = result
+    return result
+
+
+def _resolve_targets(ctx: ModuleContext, node: ast.AST) -> List[ast.AST]:
+    """Same-module defs/lambdas the mapped callable certainly denotes;
+    empty when the target is wrapped, imported, an attribute, or a name
+    that is also rebound/shadowed somewhere in the module (no guessing —
+    a partial changes the effective arity)."""
+    if isinstance(node, ast.Lambda):
+        return [node]
+    if isinstance(node, ast.Name) and node.id not in _rebound_names(ctx):
+        return list(ctx._defs_by_name.get(node.id, ()))
+    return []
+
+
+def _fits(fn: ast.AST, n: int) -> bool:
+    """Can ``fn`` accept exactly ``n`` positional arguments?"""
+    args = fn.args
+    if args.vararg is not None:
+        return True
+    total = len(args.posonlyargs) + len(args.args)
+    required = total - len(args.defaults)
+    return required <= n <= total
+
+
+class VmapInAxesArity(Rule):
+    name = "vmap-in-axes-arity"
+    default_severity = "error"
+    description = (
+        "vmap in_axes tuple length disagrees with the mapped function's "
+        "arity or the immediate call — raises only at trace time, far "
+        "from the mistake"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in _VMAP_NAMES or not node.args:
+                continue
+            axes = _in_axes_literal(node)
+            if axes is None:
+                continue
+            n = len(axes.elts)
+
+            targets = _resolve_targets(ctx, node.args[0])
+            if targets and not any(_fits(t, n) for t in targets):
+                names = getattr(node.args[0], "id", "<lambda>")
+                yield (
+                    axes.lineno,
+                    axes.col_offset,
+                    f"in_axes has {n} entr{'y' if n == 1 else 'ies'} but "
+                    f"`{names}` cannot take {n} positional argument(s) — "
+                    "the axes spec and the signature drifted apart",
+                )
+                continue  # one finding per call is enough
+
+            parent = ctx.parents.get(node)
+            if (
+                isinstance(parent, ast.Call)
+                and parent.func is node
+                and not parent.keywords
+                and not any(isinstance(a, ast.Starred) for a in parent.args)
+                and len(parent.args) != n
+            ):
+                yield (
+                    axes.lineno,
+                    axes.col_offset,
+                    f"in_axes has {n} entr{'y' if n == 1 else 'ies'} but "
+                    f"the vmapped call passes {len(parent.args)} "
+                    "argument(s) — every mapped argument needs its axis "
+                    "(and vice versa)",
+                )

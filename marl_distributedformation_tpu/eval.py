@@ -50,10 +50,22 @@ def episode_length(params: EnvParams) -> int:
     return params.max_steps + (2 if params.strict_parity else 0)
 
 
-@functools.partial(jax.jit, static_argnames=("act_fn", "params", "num_formations"))
-def _run_episodes(
-    key: Array, act_fn: ActFn, params: EnvParams, num_formations: int
+def run_episode_metrics(
+    key: Array,
+    act_fn: ActFn,
+    params: EnvParams,
+    num_formations: int,
+    scenario_params=None,
 ) -> Dict[str, Array]:
+    """Full-episode metric scan — the traceable core shared by the jitted
+    ``_run_episodes`` below and the robustness-matrix runner
+    (``scenarios/matrix.py``, which threads model params AND scenario
+    params as traced inputs so one compiled program serves the whole
+    scenario x severity x checkpoint grid).
+
+    ``scenario_params`` (``scenarios.ScenarioParams`` or None) routes the
+    env step through the disturbance stack; None is the clean env.
+    """
     # Reset uses ``key`` unchanged (NOT a split): recorded eval artifacts
     # compare controllers on identical initial states across runs, so the
     # seed -> initial-state mapping must stay stable. The action-noise
@@ -63,11 +75,21 @@ def _run_episodes(
     obs0 = compute_obs(state.agents, state.goal, params)
     T = episode_length(params)
 
+    if scenario_params is None:
+        env_step = step_batch
+    else:
+        from marl_distributedformation_tpu.scenarios import (
+            scenario_step_batch,
+        )
+
+        def env_step(state, vel, params):
+            return scenario_step_batch(state, vel, scenario_params, params)
+
     def body(carry, _):
         state, obs, act_key = carry
         act_key, k = jax.random.split(act_key)
         vel = act_fn(state.agents, state.goal, state.obstacles, obs, k)
-        state, tr = step_batch(state, vel, params)
+        state, tr = env_step(state, vel, params)
         step_out = {
             "reward": tr.reward.mean(),  # mean over formations x agents
             "avg_dist_to_goal": tr.metrics["avg_dist_to_goal"].mean(),
@@ -99,17 +121,51 @@ def _run_episodes(
     }
 
 
+# Jitted wrapper: act_fn/params/num_formations are static (an eval run
+# compares a handful of controllers), scenario params ride as traced
+# inputs — scenario/severity changes never recompile.
+_run_episodes = jax.jit(
+    run_episode_metrics,
+    static_argnames=("act_fn", "params", "num_formations"),
+)
+
+
 def evaluate(
     act_fn: ActFn,
     params: EnvParams,
     num_formations: int = 1024,
     seed: int = 1234,
+    scenario_params=None,
 ) -> Dict[str, float]:
-    """Run one full episode on M formations; returns host-side floats."""
+    """Run one full episode on M formations; returns host-side floats.
+    ``scenario_params`` evaluates under a disturbance scenario
+    (``scenarios.scenario_params_for(name, severity)``)."""
     out = _run_episodes(
-        jax.random.PRNGKey(seed), act_fn, params, num_formations
+        jax.random.PRNGKey(seed), act_fn, params, num_formations,
+        scenario_params,
     )
     return {k: float(v) for k, v in out.items()}
+
+
+def evaluate_scenario(
+    act_fn: ActFn,
+    params: EnvParams,
+    scenario: str,
+    severity: float,
+    num_formations: int = 1024,
+    seed: int = 1234,
+) -> Dict[str, float]:
+    """``evaluate`` under a registered scenario by name — unknown names
+    fail fast with the registry listing (scenarios/registry.py)."""
+    from marl_distributedformation_tpu.scenarios import scenario_params_for
+
+    return evaluate(
+        act_fn,
+        params,
+        num_formations=num_formations,
+        seed=seed,
+        scenario_params=scenario_params_for(scenario, severity),
+    )
 
 
 def baseline_act_fn(params: EnvParams) -> ActFn:
@@ -175,13 +231,18 @@ def evaluate_checkpoint(
     num_formations: int = 1024,
     seed: int = 1234,
     deterministic: bool = True,
+    scenario_params=None,
 ) -> Dict[str, float]:
     """Restore a trainer checkpoint and evaluate its policy (mode action
-    by default; ``deterministic=False`` samples — see ``policy_act_fn``)."""
+    by default; ``deterministic=False`` samples — see ``policy_act_fn``).
+    ``scenario_params`` evaluates under a disturbance scenario."""
     from marl_distributedformation_tpu.compat.policy import LoadedPolicy
 
     pol = LoadedPolicy.from_checkpoint(
         checkpoint_path, act_dim=params.act_dim, env_params=params
     )
     act = policy_act_fn(pol.model, pol.params, params, deterministic)
-    return evaluate(act, params, num_formations=num_formations, seed=seed)
+    return evaluate(
+        act, params, num_formations=num_formations, seed=seed,
+        scenario_params=scenario_params,
+    )

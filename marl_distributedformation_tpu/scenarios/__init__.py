@@ -1,0 +1,43 @@
+"""Compile-once disturbance & scenario engine (docs/scenarios.md).
+
+Declarative, pure-JAX scenario variants of the formation env: composable
+perturbation layers (``layers.py``) stack around ``env/formation.py``'s
+step without forking it, every scenario/severity knob is a traced input
+(``params.py``), and a ``ScenarioSpec`` registry (``registry.py``) names
+the recipes — so ONE jitted train or eval step serves every registered
+scenario at every severity with zero recompiles, and a batch can mix
+scenarios per formation (``sample_scenario_batch``).
+"""
+
+from marl_distributedformation_tpu.scenarios.params import (  # noqa: F401
+    ScenarioParams,
+    broadcast_params,
+)
+from marl_distributedformation_tpu.scenarios.layers import (  # noqa: F401
+    neighbor_obs_columns,
+    perturb_goal,
+    perturb_obs,
+    perturb_velocity,
+)
+from marl_distributedformation_tpu.scenarios.engine import (  # noqa: F401
+    make_scenario_step,
+    scenario_step,
+    scenario_step_batch,
+)
+from marl_distributedformation_tpu.scenarios.registry import (  # noqa: F401
+    ScenarioSpec,
+    get_scenario,
+    register_scenario,
+    registered_scenarios,
+    sample_scenario_batch,
+    scenario_params_for,
+)
+from marl_distributedformation_tpu.scenarios.schedule import (  # noqa: F401
+    ScenarioSchedule,
+    ScenarioStage,
+    schedule_from_cfg,
+)
+from marl_distributedformation_tpu.scenarios.matrix import (  # noqa: F401
+    make_matrix_runner,
+    run_matrix,
+)

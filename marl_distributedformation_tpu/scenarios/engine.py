@@ -1,0 +1,103 @@
+"""Scenario engine: the clean env step wrapped in the disturbance stack.
+
+``scenario_step`` composes the layers (``layers.py``) around
+``env/formation.py``'s ``step`` in a fixed order — goal transforms,
+actuator transforms, clean step, observation transforms — without forking
+the env. ``scenario_step_batch`` is the vmapped form and accepts the
+scenario parameters either unbatched (every formation runs the same
+scenario — the eval shape) or with a leading ``(M,)`` axis (a mixed batch
+— the domain-randomization training shape); which one is a static
+property of the pytree's shapes, so both share the same code path.
+
+Everything scenario-specific is *data* (``ScenarioParams``), so a jitted
+caller that takes the params as an argument compiles exactly once for
+every registered scenario at every severity (pinned with budget-1
+``analysis.guards.RetraceGuard`` in tests/test_scenarios.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import jax
+
+from marl_distributedformation_tpu.env.formation import compute_obs, step
+from marl_distributedformation_tpu.env.types import (
+    EnvParams,
+    FormationState,
+    Transition,
+)
+from marl_distributedformation_tpu.scenarios.layers import (
+    perturb_goal,
+    perturb_obs,
+    perturb_velocity,
+)
+from marl_distributedformation_tpu.scenarios.params import ScenarioParams
+
+Array = jax.Array
+
+
+def scenario_step(
+    state: FormationState,
+    velocity: Array,
+    sp: ScenarioParams,
+    params: EnvParams,
+    with_obs: bool = True,
+) -> Tuple[FormationState, Transition]:
+    """One formation, one step, through the disturbance stack."""
+    state = perturb_goal(state, sp, params)
+    velocity = perturb_velocity(velocity, state, sp, params)
+    next_state, tr = step(state, velocity, params, with_obs=with_obs)
+    if with_obs:
+        tr = tr.replace(obs=perturb_obs(tr.obs, next_state, sp, params))
+    return next_state, tr
+
+
+def _params_axis(sp: ScenarioParams) -> int | None:
+    """0 when the params carry a per-formation batch axis, else None —
+    a static (shape-level) property, safe to branch on at trace time."""
+    return 0 if sp.fault_prob.ndim else None
+
+
+def scenario_step_batch(
+    state: FormationState,
+    velocity: Array,
+    sp: ScenarioParams,
+    params: EnvParams,
+) -> Tuple[FormationState, Transition]:
+    """Batched scenario step — the disturbance-stacked ``step_batch``.
+
+    Mirrors ``step_batch``'s knn routing: the per-formation step runs
+    without obs and the neighbor-graph observation is computed once over
+    the whole batch (so the fused Pallas search sees ``(M, N, 2)``), then
+    the observation layers run on the batch.
+    """
+    axis = _params_axis(sp)
+    if params.obs_mode == "knn":
+        next_state, tr = jax.vmap(
+            functools.partial(scenario_step, with_obs=False),
+            in_axes=(0, 0, axis, None),
+        )(state, velocity, sp, params)
+        obs = compute_obs(next_state.agents, next_state.goal, params)
+        obs = jax.vmap(perturb_obs, in_axes=(0, 0, axis, None))(
+            obs, next_state, sp, params
+        )
+        return next_state, tr.replace(obs=obs)
+    return jax.vmap(scenario_step, in_axes=(0, 0, axis, None))(
+        state, velocity, sp, params
+    )
+
+
+def make_scenario_step(
+    params: EnvParams,
+) -> Callable[[FormationState, Array, ScenarioParams], Tuple[FormationState, Transition]]:
+    """``(state, velocity, scenario_params) -> (state, transition)`` closed
+    over the static env params — the trainer's scenario ``env_step_fn``
+    (the scenario params stay a traced argument, never a closure
+    constant, so severity schedules never recompile)."""
+
+    def step_fn(state, velocity, sp):
+        return scenario_step_batch(state, velocity, sp, params)
+
+    return step_fn

@@ -1,0 +1,174 @@
+"""Composable disturbance layers: pure functions around the clean env step.
+
+Each layer is a ``(ScenarioParams, state, value) -> value`` transform that
+stacks around ``env/formation.py``'s ``step`` without forking it:
+
+- ``perturb_goal`` (pre-step, state transform): moving formation targets
+  (the goal drifts along a per-episode heading) and mid-episode target
+  switching (at ``max_steps // 2`` the goal jumps toward a freshly
+  sampled location by ``goal_jump``);
+- ``perturb_velocity`` (pre-step, action transform): agent fault
+  injection (per-episode frozen agents — actuator dropout), Gaussian +
+  constant-bias actuator noise, and a constant + gusting wind field;
+- ``perturb_obs`` (post-step, observation transform): Gaussian +
+  constant-bias sensor noise, and comm dropout that masks the
+  neighbor-derived observation blocks per agent per step (ring-neighbor
+  offsets in ``ring`` mode; offsets/distances/indices in ``knn`` mode).
+
+Randomness derives from the formation's own PRNG stream via ``fold_in``
+with per-layer salts — the env's key is read, never consumed, so the
+underlying clean trajectory (resets included) is untouched. Every layer
+is guarded with ``jnp.where(magnitude > 0, perturbed, clean)``: at zero
+magnitude the output is the clean value **bitwise** (not just within
+epsilon — ``x + 0.0`` would already flip ``-0.0`` signs), which is what
+lets severity-0 scenarios reproduce the clean env trajectory exactly
+(tests/test_scenarios.py) while the disturbance math stays inside one
+compiled program for every scenario.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from marl_distributedformation_tpu.env.types import EnvParams, FormationState
+from marl_distributedformation_tpu.scenarios.params import ScenarioParams
+
+Array = jax.Array
+
+# Per-layer fold_in salts (arbitrary, distinct; stable across versions so
+# recorded robustness numbers stay reproducible).
+_SALT_FAULT = 0x5C01
+_SALT_ACT_NOISE = 0x5C02
+_SALT_ACT_BIAS = 0x5C03
+_SALT_GUST = 0x5C04
+_SALT_GOAL_DIR = 0x5C05
+_SALT_GOAL_SWITCH = 0x5C06
+_SALT_OBS_NOISE = 0x5C07
+_SALT_OBS_BIAS = 0x5C08
+_SALT_COMM = 0x5C09
+
+
+def _episode_key(state: FormationState, salt: int) -> Array:
+    """Constant within an episode (``state.key`` only changes at reset)."""
+    return jax.random.fold_in(state.key, salt)
+
+
+def _step_key(state: FormationState, salt: int) -> Array:
+    """Fresh every step (folds the step counter on top of the salt)."""
+    return jax.random.fold_in(_episode_key(state, salt), state.steps)
+
+
+def _unit_heading(key: Array) -> Array:
+    theta = jax.random.uniform(key, (), minval=0.0, maxval=2.0 * jnp.pi)
+    return jnp.stack([jnp.cos(theta), jnp.sin(theta)])
+
+
+def perturb_goal(
+    state: FormationState, sp: ScenarioParams, params: EnvParams
+) -> FormationState:
+    """Pre-step goal transforms: drift + mid-episode switch (module doc)."""
+    wh = jnp.array([params.width, params.height], jnp.float32)
+
+    # Moving target: constant per-episode heading, clipped to the world.
+    k_dir = _episode_key(state, _SALT_GOAL_DIR)
+    moved = jnp.clip(state.goal + sp.goal_speed * _unit_heading(k_dir), 0.0, wh)
+    goal = jnp.where(sp.goal_speed > 0, moved, state.goal)
+
+    # Mid-episode switch: at max_steps // 2 the goal jumps ``goal_jump``
+    # of the way to a fresh uniformly sampled target (1.0 = full resample,
+    # continuous in severity so severity-0 is the identity).
+    k_switch = _episode_key(state, _SALT_GOAL_SWITCH)
+    margin = params.desired_radius
+    fresh = (
+        jax.random.uniform(k_switch, (2,), dtype=jnp.float32)
+        * (wh - 2.0 * margin)
+        + margin
+    )
+    at_switch = state.steps == params.max_steps // 2
+    switched = goal + sp.goal_jump * (fresh - goal)
+    goal = jnp.where(at_switch & (sp.goal_jump > 0), switched, goal)
+    return state.replace(goal=goal)
+
+
+def perturb_velocity(
+    velocity: Array, state: FormationState, sp: ScenarioParams, params: EnvParams
+) -> Array:
+    """Pre-step action transforms: fault -> actuator noise -> wind."""
+    del params  # layers are world-unit-native; kept for signature symmetry
+    n = velocity.shape[-2]
+
+    # Agent fault injection: a per-episode frozen set (actuator dropout —
+    # the locality stress: neighbors of a dead agent must absorb it).
+    k_fault = _episode_key(state, _SALT_FAULT)
+    frozen = jax.random.bernoulli(
+        k_fault, jnp.clip(sp.fault_prob, 0.0, 1.0), (n,)
+    )
+    faulted = jnp.where(frozen[..., None], 0.0, velocity)
+    velocity = jnp.where(sp.fault_prob > 0, faulted, velocity)
+
+    # Gaussian actuator noise + constant per-episode bias (miscalibrated
+    # thrusters: zero-mean jitter plus a systematic drift direction).
+    k_act = _step_key(state, _SALT_ACT_NOISE)
+    k_bias = _episode_key(state, _SALT_ACT_BIAS)
+    noisy = (
+        velocity
+        + sp.act_noise_sigma * jax.random.normal(k_act, velocity.shape)
+        + sp.act_bias * _unit_heading(k_bias)
+    )
+    velocity = jnp.where(
+        (sp.act_noise_sigma > 0) | (sp.act_bias > 0), noisy, velocity
+    )
+
+    # Wind field: constant vector + per-step formation-wide gust.
+    k_gust = _step_key(state, _SALT_GUST)
+    blown = velocity + sp.wind + sp.gust_sigma * jax.random.normal(k_gust, (2,))
+    windy = (jnp.abs(sp.wind).sum() > 0) | (sp.gust_sigma > 0)
+    return jnp.where(windy, blown, velocity)
+
+
+def neighbor_obs_columns(params: EnvParams) -> np.ndarray:
+    """Static ``(obs_dim,)`` mask of the neighbor-derived observation
+    columns — what comm dropout blanks. ``ring``: the prev/next offset
+    blocks (layout in ``compute_obs``). ``knn``: the k-neighbor
+    offsets/distances plus the trailing index block (layout in
+    ``_assemble_knn_obs``). Own position and the goal stay visible —
+    dropped comm, not a dead sensor."""
+    cols = np.zeros((params.obs_dim,), dtype=bool)
+    if params.obs_mode == "ring":
+        cols[2:6] = True
+    else:
+        k = params.knn_k
+        cols[2 : 2 + 3 * k] = True
+        cols[params.obs_dim - k :] = True
+    return cols
+
+
+def perturb_obs(
+    obs: Array, state: FormationState, sp: ScenarioParams, params: EnvParams
+) -> Array:
+    """Post-step observation transforms: sensor noise -> comm dropout.
+
+    ``state`` is the post-step state the observation belongs to; only the
+    *observed* values change — rewards, metrics, and the physical state
+    stay exact (sensors lie, the world doesn't)."""
+    # Gaussian sensor noise + constant per-episode per-column bias.
+    k_obs = _step_key(state, _SALT_OBS_NOISE)
+    k_bias = _episode_key(state, _SALT_OBS_BIAS)
+    noisy = (
+        obs
+        + sp.obs_noise_sigma * jax.random.normal(k_obs, obs.shape)
+        + sp.obs_bias * jax.random.normal(k_bias, (obs.shape[-1],))
+    )
+    obs = jnp.where((sp.obs_noise_sigma > 0) | (sp.obs_bias > 0), noisy, obs)
+
+    # Comm dropout: per agent per step, blank the neighbor blocks.
+    cols = jnp.asarray(neighbor_obs_columns(params))
+    k_drop = _step_key(state, _SALT_COMM)
+    dropped = jax.random.bernoulli(
+        k_drop, jnp.clip(sp.comm_drop_prob, 0.0, 1.0), (obs.shape[-2],)
+    )
+    masked = jnp.where(dropped[..., None] & cols, 0.0, obs)
+    return jnp.where(sp.comm_drop_prob > 0, masked, obs)

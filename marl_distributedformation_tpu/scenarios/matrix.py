@@ -1,0 +1,129 @@
+"""Robustness evaluation matrix: scenarios x severities x checkpoints.
+
+One compiled program serves the whole grid: the episode runner takes the
+*model parameters* and the *scenario parameters* as traced inputs (only
+the architecture and env geometry are static), so sweeping 9 scenarios x
+3 severities x K same-architecture checkpoints compiles exactly once —
+pinned by a budget-1 ``analysis.guards.RetraceGuard``. Identical initial
+states across every cell (the eval-seed convention of ``eval.py``), so
+cells are directly comparable.
+
+CLI: ``scripts/robustness_matrix.py`` (one JSON report per run).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from marl_distributedformation_tpu.analysis.guards import RetraceGuard
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.eval import (
+    policy_act_fn,
+    run_episode_metrics,
+)
+from marl_distributedformation_tpu.scenarios.registry import get_scenario
+
+Array = jax.Array
+
+
+def make_matrix_runner(
+    model,
+    env_params: EnvParams,
+    num_formations: int,
+    deterministic: bool = True,
+    max_traces: Optional[int] = 1,
+) -> Tuple:
+    """Build ``(run, guard)``: ``run(key, model_params, scenario_params)``
+    -> episode metrics, jitted once for the whole matrix (``guard`` is the
+    budget-``max_traces`` RetraceGuard wrapping it)."""
+    guard = RetraceGuard("robustness_matrix_eval", max_traces=max_traces)
+
+    def episode(key, model_params, scenario_params):
+        act = policy_act_fn(model, model_params, env_params, deterministic)
+        return run_episode_metrics(
+            key, act, env_params, num_formations, scenario_params
+        )
+
+    return jax.jit(guard.wrap(episode)), guard
+
+
+def run_matrix(
+    checkpoint_paths: Sequence[str],
+    env_params: EnvParams,
+    scenarios: Sequence[str],
+    severities: Sequence[float],
+    num_formations: int = 256,
+    seed: int = 1234,
+    deterministic: bool = True,
+) -> Dict:
+    """Sweep every checkpoint over scenarios x severities.
+
+    Checkpoints must share one architecture (one run's checkpoint series
+    — validated, a mismatch names the offending file). Returns the report
+    dict: ``matrix[checkpoint][scenario][severity] -> metrics`` plus the
+    compile count (the zero-recompile receipt).
+    """
+    from marl_distributedformation_tpu.compat.policy import LoadedPolicy
+
+    if not checkpoint_paths:
+        raise ValueError("run_matrix needs at least one checkpoint path")
+    specs = [get_scenario(str(name)) for name in scenarios]  # fail fast
+
+    policies = [
+        LoadedPolicy.from_checkpoint(
+            str(p), act_dim=env_params.act_dim, env_params=env_params
+        )
+        for p in checkpoint_paths
+    ]
+    def signature(params):
+        # Structure AND leaf shapes/dtypes: same-structure checkpoints
+        # with different widths would otherwise pass, then blow the
+        # budget-1 guard mid-sweep with a confusing retrace error.
+        return jax.tree_util.tree_structure(params), [
+            (jnp.shape(leaf), jnp.asarray(leaf).dtype)
+            for leaf in jax.tree_util.tree_leaves(params)
+        ]
+
+    reference = signature(policies[0].params)
+    for path, pol in zip(checkpoint_paths, policies):
+        if signature(pol.params) != reference:
+            raise ValueError(
+                f"checkpoint {path} has a different parameter "
+                "structure/shape than the first checkpoint — the matrix "
+                "shares one compiled program, so all checkpoints must be "
+                "one architecture (run separate matrices per architecture)"
+            )
+
+    run, guard = make_matrix_runner(
+        policies[0].model, env_params, num_formations, deterministic
+    )
+    key = jax.random.PRNGKey(seed)
+
+    matrix: Dict[str, Dict[str, Dict[str, Dict[str, float]]]] = {}
+    for path, pol in zip(checkpoint_paths, policies):
+        per_scenario: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for spec in specs:
+            per_severity: Dict[str, Dict[str, float]] = {}
+            for severity in severities:
+                sp = spec.build(jnp.float32(severity))
+                out = run(key, pol.params, sp)
+                per_severity[f"{float(severity):g}"] = {
+                    k: float(v) for k, v in out.items()
+                }
+            per_scenario[spec.name] = per_severity
+        matrix[str(path)] = per_scenario
+
+    return {
+        "scenarios": [spec.name for spec in specs],
+        "severities": [float(s) for s in severities],
+        "checkpoints": [str(p) for p in checkpoint_paths],
+        "eval_formations": num_formations,
+        "num_agents": env_params.num_agents,
+        "seed": seed,
+        "deterministic": deterministic,
+        "matrix": matrix,
+        "eval_compiles": guard.count,
+    }

@@ -1,0 +1,72 @@
+"""Traced scenario parameters: the data that *is* the scenario.
+
+Every disturbance layer (``layers.py``) reads its magnitudes from this
+pytree, and every field is a jnp array — a **traced input** to the jitted
+step, never a Python constant baked into the program. That inversion is
+the whole design: one compiled train/eval step covers every registered
+scenario at every severity, because switching scenario or severity only
+changes *values*, never shapes, dtypes, or program structure (the
+JaxMARL/Jumanji recipe for scenario suites — parameterized variants in
+one program, not a zoo of env subclasses).
+
+Shapes: scalars are ``()`` per formation; a batch of formations carries a
+leading ``(M,)`` axis on every leaf (``(M, 2)`` for ``wind``) so one
+vmapped step can mix scenarios across the batch. ``ScenarioParams.zeros``
+is the identity element: every layer is a bitwise no-op at all-zero
+parameters (pinned by tests/test_scenarios.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class ScenarioParams:
+    """Per-formation disturbance magnitudes (all traced, see module doc).
+
+    Layer order of application is fixed (docs/scenarios.md): goal
+    transforms -> actuator transforms -> env step -> observation
+    transforms.
+    """
+
+    fault_prob: jax.Array  # () in [0,1] — per-agent per-episode freeze prob
+    act_noise_sigma: jax.Array  # () px/step — Gaussian actuator noise
+    act_bias: jax.Array  # () px/step — constant per-episode actuator bias
+    wind: jax.Array  # (2,) px/step — constant wind velocity field
+    gust_sigma: jax.Array  # () px/step — per-step formation-wide gust
+    goal_speed: jax.Array  # () px/step — goal drift along an episode heading
+    goal_jump: jax.Array  # () in [0,1] — mid-episode goal switch fraction
+    obs_noise_sigma: jax.Array  # () obs units — Gaussian sensor noise
+    obs_bias: jax.Array  # () obs units — constant per-episode sensor bias
+    comm_drop_prob: jax.Array  # () in [0,1] — per-step neighbor-block dropout
+
+    @classmethod
+    def zeros(cls) -> "ScenarioParams":
+        """The identity scenario (clean env, bitwise)."""
+        z = jnp.zeros((), jnp.float32)
+        return cls(
+            fault_prob=z,
+            act_noise_sigma=z,
+            act_bias=z,
+            wind=jnp.zeros((2,), jnp.float32),
+            gust_sigma=z,
+            goal_speed=z,
+            goal_jump=z,
+            obs_noise_sigma=z,
+            obs_bias=z,
+            comm_drop_prob=z,
+        )
+
+
+def broadcast_params(sp: ScenarioParams, num_formations: int) -> ScenarioParams:
+    """Tile one formation's params to a ``(M,)``-leading batch (every
+    formation runs the same scenario — the eval-matrix shape)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.broadcast_to(
+            leaf, (num_formations, *jnp.shape(leaf))
+        ),
+        sp,
+    )

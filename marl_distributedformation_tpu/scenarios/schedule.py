@@ -1,0 +1,178 @@
+"""Severity schedules + domain-randomization stages for scenario training.
+
+The shape mirrors ``train/curriculum.py``'s ``Curriculum``/``CurriculumStage``
+(the repo's existing staged-training idiom): an ordered tuple of stages,
+each naming the scenario subset to randomize over and a severity ramp.
+Unlike the hetero curriculum — whose stage boundaries rebuild env state —
+a scenario stage transition is pure data (a new probs vector + severity
+scalar into the SAME compiled program), so schedules never recompile and
+compose with ``iters_per_dispatch`` bursts.
+
+Config forms accepted by ``schedule_from_cfg`` (cfg key ``scenarios``):
+
+- a list of names: one flat stage at ``scenario_severity``
+  (``scenarios=[wind,sensor_noise] scenario_severity=0.6``);
+- a list of stage dicts (YAML string or parsed), each
+  ``{rollouts, scenarios, severity, severity_start?}`` — severity ramps
+  linearly from ``severity_start`` (default: previous stage's end, 0 for
+  the first) to ``severity`` over the stage's rollouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from marl_distributedformation_tpu.scenarios.registry import get_scenario
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioStage:
+    """One schedule phase: randomize over ``scenarios`` while severity
+    ramps ``severity_start -> severity`` across ``rollouts``."""
+
+    rollouts: int
+    scenarios: Tuple[str, ...]
+    severity: float = 0.5
+    severity_start: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        # User config reaches here — real raises, not asserts (asserts
+        # vanish under -O and name neither the stage nor the key).
+        if self.rollouts <= 0:
+            raise ValueError(
+                f"scenario stage {self.scenarios!r}: rollouts must be "
+                f"positive, got {self.rollouts}"
+            )
+        if not self.scenarios:
+            raise ValueError("a scenario stage needs at least one scenario")
+        for name in self.scenarios:
+            get_scenario(name)  # fail fast at construction, naming entries
+        if self.severity < 0.0:
+            raise ValueError(
+                f"scenario stage {self.scenarios!r}: severity must be "
+                f"non-negative, got {self.severity}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSchedule:
+    """An ordered sequence of stages; indexing past the end holds the
+    last stage at its end severity (runs whose budget outlives the
+    schedule keep training at the final difficulty)."""
+
+    stages: Tuple[ScenarioStage, ...]
+
+    def __post_init__(self) -> None:
+        if not self.stages:
+            raise ValueError("a scenario schedule needs at least one stage")
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        """Union of every stage's scenarios, first-seen order — the fixed
+        spec axis the jitted sampler is built over."""
+        seen: List[str] = []
+        for stage in self.stages:
+            for name in stage.scenarios:
+                if name not in seen:
+                    seen.append(name)
+        return tuple(seen)
+
+    @property
+    def total_rollouts(self) -> int:
+        return sum(s.rollouts for s in self.stages)
+
+    def stage_at(self, rollout: int) -> Tuple[ScenarioStage, int]:
+        """(stage, rollout-within-stage) for a global rollout index."""
+        done = 0
+        for stage in self.stages:
+            if rollout < done + stage.rollouts:
+                return stage, rollout - done
+            done += stage.rollouts
+        last = self.stages[-1]
+        return last, last.rollouts - 1
+
+    def severity_at(self, rollout: int) -> float:
+        """Host-side severity for a global rollout index (linear ramp
+        within the stage; stage starts default to the previous end)."""
+        start = 0.0
+        done = 0
+        for stage in self.stages:
+            lo = stage.severity_start if stage.severity_start is not None else start
+            if rollout < done + stage.rollouts:
+                frac = (
+                    (rollout - done) / (stage.rollouts - 1)
+                    if stage.rollouts > 1
+                    else 1.0
+                )
+                return float(lo + (stage.severity - lo) * frac)
+            start = stage.severity
+            done += stage.rollouts
+        return float(self.stages[-1].severity)
+
+    def probs_at(self, rollout: int) -> np.ndarray:
+        """Uniform distribution over the active stage's scenarios, laid
+        out on the schedule's union ``names`` axis (zeros elsewhere)."""
+        stage, _ = self.stage_at(rollout)
+        names = self.names
+        probs = np.zeros((len(names),), np.float32)
+        for name in stage.scenarios:
+            probs[names.index(name)] = 1.0
+        return probs / probs.sum()
+
+
+def schedule_from_cfg(
+    cfg: Any, default_severity: float = 0.5
+) -> ScenarioSchedule:
+    """Build a schedule from the ``scenarios`` config value (module doc).
+    A YAML string (quoted CLI override) is parsed first."""
+    if isinstance(cfg, str):
+        import yaml
+
+        cfg = yaml.safe_load(cfg)
+    if not isinstance(cfg, (list, tuple)) or not cfg:
+        raise ValueError(
+            "scenarios must be a non-empty list of scenario names or "
+            f"stage dicts, got {cfg!r}"
+        )
+    if all(isinstance(entry, str) for entry in cfg):
+        return ScenarioSchedule(
+            stages=(
+                ScenarioStage(
+                    rollouts=1,
+                    scenarios=tuple(cfg),
+                    severity=float(default_severity),
+                    severity_start=float(default_severity),
+                ),
+            )
+        )
+    stages = []
+    for entry in cfg:
+        if not isinstance(entry, dict):
+            raise ValueError(
+                "scenario stages must all be dicts (or all names), got "
+                f"{entry!r}"
+            )
+        unknown = set(entry) - {
+            "rollouts", "scenarios", "severity", "severity_start",
+        }
+        if unknown:
+            raise ValueError(
+                f"unknown scenario-stage keys {sorted(unknown)}; valid: "
+                "rollouts, scenarios, severity, severity_start"
+            )
+        stages.append(
+            ScenarioStage(
+                rollouts=int(entry.get("rollouts", 1)),
+                scenarios=tuple(str(n) for n in entry["scenarios"]),
+                severity=float(entry.get("severity", default_severity)),
+                severity_start=(
+                    float(entry["severity_start"])
+                    if entry.get("severity_start") is not None
+                    else None
+                ),
+            )
+        )
+    return ScenarioSchedule(stages=tuple(stages))

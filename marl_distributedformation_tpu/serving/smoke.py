@@ -40,6 +40,8 @@ def run_smoke_benchmark(
     deterministic: bool = True,
     seed: int = 0,
     registry: Optional[object] = None,
+    scenario: Optional[str] = None,
+    scenario_severity: float = 1.0,
 ) -> Dict[str, float]:
     """Run ``num_clients`` request loops for ``duration_s`` seconds.
 
@@ -47,7 +49,21 @@ def run_smoke_benchmark(
     in-flight mix stays heterogeneous) with observations drawn from a
     seeded RNG. Returns the merged report; raises nothing on
     backpressure/timeouts — they are part of what is being measured.
+
+    ``scenario`` perturbs the request observations with the named
+    scenario's *sensor-noise* magnitudes from the registry
+    (``scenarios/registry.py``, scaled by ``scenario_severity``) — smoke
+    the serving path on the same disturbed inputs a robustness eval
+    feeds the policy (unknown names fail fast with the registry listing).
     """
+    obs_sigma = obs_bias_scale = 0.0
+    if scenario is not None:
+        from marl_distributedformation_tpu.scenarios import get_scenario
+
+        spec = get_scenario(scenario)
+        obs_sigma = float(spec.obs_noise_sigma) * float(scenario_severity)
+        obs_bias_scale = float(spec.obs_bias) * float(scenario_severity)
+
     client = ServingClient(scheduler, max_retries=2)
     counts = {"ok": 0, "rejected": 0, "timed_out": 0}
     lock = threading.Lock()
@@ -55,11 +71,22 @@ def run_smoke_benchmark(
 
     def loop(idx: int) -> None:
         rng = np.random.default_rng(seed + idx)
+        if scenario is not None:
+            # Constant per-client sensor bias (the layer's per-episode
+            # bias). Drawn only under a scenario so scenario-free smokes
+            # keep their seeded obs streams unchanged.
+            bias = obs_bias_scale * rng.standard_normal(
+                row_shape, dtype=np.float32
+            )
         i = idx  # offset the size cycle per client
         while time.perf_counter() < stop_at:
             n = int(sizes[i % len(sizes)])
             i += 1
             obs = rng.standard_normal((n, *row_shape), dtype=np.float32)
+            if scenario is not None:
+                obs = obs + obs_sigma * rng.standard_normal(
+                    obs.shape, dtype=np.float32
+                ) + bias
             try:
                 actions, _ = client.predict(
                     obs, deterministic=deterministic
@@ -96,6 +123,9 @@ def run_smoke_benchmark(
     report["rows_per_sec"] = (
         report["rows"] / elapsed if elapsed > 0 else 0.0
     )
+    if scenario is not None:
+        report["scenario"] = scenario
+        report["scenario_severity"] = float(scenario_severity)
     for bucket, n in scheduler.engine.compile_counts().items():
         report[f"compiles_bucket_{bucket}"] = float(n)
     if registry is not None:

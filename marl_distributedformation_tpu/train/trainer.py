@@ -120,6 +120,7 @@ def make_ppo_iteration(
     ppo: PPOConfig,
     per_formation: bool = False,
     env_step_fn: Any = None,
+    scenario_step_fn: Any = None,
 ):
     """Build the functional training iteration: rollout + GAE + all
     minibatch epochs as one pure function
@@ -129,6 +130,13 @@ def make_ppo_iteration(
     Module-level (not a Trainer method) so other shells can transform it:
     ``Trainer`` jits it directly; ``SweepTrainer`` (train/sweep.py) vmaps
     it over a population of seeds before jitting.
+
+    ``scenario_step_fn`` (``scenarios.make_scenario_step``) routes env
+    stepping through the disturbance stack; the iteration then takes the
+    batched ``ScenarioParams`` as a fifth, *traced* argument — severity
+    schedules and per-formation scenario mixes are pure data, so the
+    compiled program never changes (tests/test_scenarios.py pins the
+    compile-once contract).
     """
     if per_formation:
         # Minibatch whole formations: rows are (N, ...) blocks so the
@@ -148,7 +156,13 @@ def make_ppo_iteration(
         env_state,
         obs: Array,
         key: Array,
+        *scenario_args,
     ) -> Tuple[TrainState, Any, Array, Array, Dict[str, Array]]:
+        if scenario_step_fn is not None:
+            (scenario_params,) = scenario_args
+            step_fn = lambda s, v: scenario_step_fn(s, v, scenario_params)  # noqa: E731
+        else:
+            step_fn = env_step_fn
         key, k_roll, k_update = jax.random.split(key, 3)
         with jax.named_scope("rollout"):
             env_state, last_obs, batch, last_value = collect_rollout(
@@ -159,7 +173,7 @@ def make_ppo_iteration(
                 k_roll,
                 env_params,
                 ppo.n_steps,
-                env_step_fn=env_step_fn,
+                env_step_fn=step_fn,
             )
         with jax.named_scope("gae"):
             advantages, returns = compute_gae(
@@ -205,11 +219,14 @@ def _burst(iteration, r: int):
     (mean over the burst; ``episode_dones`` sums) so the host transfer
     stays one small pytree."""
 
-    def burst(train_state, env_state, obs, key):
+    def burst(train_state, env_state, obs, key, *extra):
+        # ``extra`` (scenario params) is constant across the fused burst —
+        # severity/mix resampling quantizes to the dispatch cadence, the
+        # same quantization logging and checkpoints already accept.
         def body(carry, _):
             train_state, env_state, obs, key = carry
             train_state, env_state, obs, key, metrics = iteration(
-                train_state, env_state, obs, key
+                train_state, env_state, obs, key, *extra
             )
             return (train_state, env_state, obs, key), metrics
 
@@ -240,6 +257,7 @@ class Trainer:
         config: TrainConfig = TrainConfig(),
         model: Any = None,
         shard_fn: Any = None,
+        scenario_schedule: Any = None,
     ) -> None:
         ppo = fill_ent_schedule(ppo, env_params, config)
         self.env_params = env_params
@@ -324,6 +342,68 @@ class Trainer:
                     self.train_state, self.env_state, self.obs
                 )
 
+        # Scenario training (scenarios/, docs/scenarios.md): env stepping
+        # routes through the disturbance stack and the iteration takes the
+        # batched ScenarioParams as a traced argument — domain
+        # randomization over the schedule's scenario set, severity ramps
+        # per stage, zero recompiles across all of it.
+        self._scenario_schedule = scenario_schedule
+        self._scenario_step_fn = None
+        self.scenario_params = None
+        self.scenario_severity = 0.0
+        if scenario_schedule is not None:
+            if self._env_step_fn is not None:
+                # Which specialized step blocked it matters for the fix:
+                # 'sp' meshes replace the env step wholesale; knn on a dp
+                # mesh wraps it in shard_map — neither is scenario-wrapped.
+                blocker = (
+                    "the agent-axis ('sp') sharded ring step — drop 'sp' "
+                    "from the mesh"
+                    if "sp" in mesh.shape
+                    else "the shard_map knn env step a dp mesh uses for "
+                    "obs_mode=knn — use obs_mode=ring on this mesh, or "
+                    "drop the mesh"
+                )
+                raise SystemExit(
+                    f"scenario training does not compose with {blocker}; "
+                    "scenarios currently wrap only the plain vmapped step"
+                )
+            if self._multihost:
+                raise SystemExit(
+                    "scenario training is single-host for now (per-host "
+                    "scenario-param construction is not wired); drop "
+                    "scenarios or run single-process"
+                )
+            from marl_distributedformation_tpu.scenarios import (
+                get_scenario,
+                make_scenario_step,
+                sample_scenario_batch,
+            )
+
+            self._scenario_specs = tuple(
+                get_scenario(n) for n in scenario_schedule.names
+            )
+            self._scenario_step_fn = make_scenario_step(env_params)
+            # One jitted sampler over the schedule's fixed scenario union:
+            # stage changes move probability mass, severity ramps scale
+            # magnitudes — both traced, so the sampler compiles once too.
+            self._sample_scenarios = jax.jit(
+                functools.partial(
+                    sample_scenario_batch,
+                    specs=self._scenario_specs,
+                    num_formations=config.num_formations,
+                )
+            )
+            # Base key for the sampling stream; per-dispatch keys fold in
+            # the global rollout index, so the stream is a pure function
+            # of (seed, rollout) and resume continues it exactly instead
+            # of replaying the first dispatches' draws.
+            self._scenario_base_key = jax.random.fold_in(
+                jax.random.PRNGKey(config.seed), 0x5CE7
+            )
+            self._scenario_rollouts = 0
+            self._resample_scenario_params()
+
         self.num_timesteps = 0
         self._vec_steps_since_save = 0
         self._iteration_core = self._make_iteration()
@@ -360,7 +440,26 @@ class Trainer:
 
     def _make_iteration(self):
         return make_ppo_iteration(
-            self.env_params, self.ppo, self.per_formation, self._env_step_fn
+            self.env_params,
+            self.ppo,
+            self.per_formation,
+            self._env_step_fn,
+            self._scenario_step_fn,
+        )
+
+    def _resample_scenario_params(self) -> None:
+        """Redraw the per-formation scenario mix at the schedule's current
+        severity (called per dispatch — fresh domain randomization every
+        rollout, values-only so the train step never retraces)."""
+        schedule = self._scenario_schedule
+        self.scenario_severity = schedule.severity_at(self._scenario_rollouts)
+        k_sample = jax.random.fold_in(
+            self._scenario_base_key, self._scenario_rollouts
+        )
+        self.scenario_params = self._sample_scenarios(
+            k_sample,
+            jnp.float32(self.scenario_severity),
+            jnp.asarray(schedule.probs_at(self._scenario_rollouts)),
         )
 
     # ------------------------------------------------------------------
@@ -383,6 +482,10 @@ class Trainer:
                 stack.enter_context(profiling.no_host_transfers())
             if self.config.guard_nans:
                 stack.enter_context(profiling.nan_guard())
+            extra = (
+                () if self.scenario_params is None
+                else (self.scenario_params,)
+            )
             (
                 self.train_state,
                 self.env_state,
@@ -390,12 +493,15 @@ class Trainer:
                 self.key,
                 metrics,
             ) = self._iteration(
-                self.train_state, self.env_state, self.obs, self.key
+                self.train_state, self.env_state, self.obs, self.key, *extra
             )
         self._dispatches += 1
         r = self._iters_per_dispatch
         self.num_timesteps += r * self.ppo.n_steps * self.num_envs
         self._vec_steps_since_save += r * self.ppo.n_steps
+        if self._scenario_schedule is not None:
+            self._scenario_rollouts += r
+            self._resample_scenario_params()
         return metrics
 
     def train(self) -> Dict[str, float]:
@@ -449,6 +555,19 @@ class Trainer:
                         k: float(v) for k, v in host_metrics.items()
                     }
                     last_record["env_steps_per_sec"] = meter.rate()
+                    if self._scenario_schedule is not None:
+                        # Severity of the NEXT dispatch was already
+                        # resampled; record the one this metrics batch
+                        # actually trained at.
+                        last_record["scenario_severity"] = float(
+                            self._scenario_schedule.severity_at(
+                                max(
+                                    self._scenario_rollouts
+                                    - self._iters_per_dispatch,
+                                    0,
+                                )
+                            )
+                        )
                     logger.log(last_record, self.num_timesteps)
                 if (
                     self.config.checkpoint
@@ -482,9 +601,20 @@ class Trainer:
         ts, env_state, obs, key = (
             self.train_state, self.env_state, self.obs, self.key,
         )
-        env_step_fn = self._env_step_fn or (
-            lambda s, v: step_batch(s, v, env_params)
-        )
+        if self.scenario_params is not None:
+            # Time the stages through the SAME disturbance stack the total
+            # runs through (params close over as trace constants here —
+            # fine for a profiling twin), or the breakdown would book the
+            # scenario layers' cost to the update phase.
+            scenario_params = self.scenario_params
+            scenario_step = self._scenario_step_fn
+
+            def env_step_fn(s, v):
+                return scenario_step(s, v, scenario_params)
+        else:
+            env_step_fn = self._env_step_fn or (
+                lambda s, v: step_batch(s, v, env_params)
+            )
         # Non-donating twin of self._iteration: the training jit donates its
         # state buffers, which repeated timing calls would invalidate.
         iteration_no_donate = jax.jit(self._iteration_core)
@@ -493,7 +623,7 @@ class Trainer:
         def rollout_only(env_state, obs, key):
             return collect_rollout(
                 ts.apply_fn, ts.params, env_state, obs, key, env_params,
-                ppo.n_steps, env_step_fn=self._env_step_fn,
+                ppo.n_steps, env_step_fn=env_step_fn,
             )[2].rewards.sum()
 
         @jax.jit
@@ -516,7 +646,7 @@ class Trainer:
         def _collect(env_state, obs, key):
             return collect_rollout(
                 ts.apply_fn, ts.params, env_state, obs, key, env_params,
-                ppo.n_steps, env_step_fn=self._env_step_fn,
+                ppo.n_steps, env_step_fn=env_step_fn,
             )
 
         _, last_obs, batch, last_value = _collect(env_state, obs, key)
@@ -562,11 +692,14 @@ class Trainer:
             jax.block_until_ready(out)
             return (time.perf_counter() - t0) / iters
 
+        extra = (
+            () if self.scenario_params is None else (self.scenario_params,)
+        )
         result = {
             "total": timed(
-                lambda: iteration_no_donate(ts, env_state, obs, key)[4][
-                    "loss"
-                ]
+                lambda: iteration_no_donate(ts, env_state, obs, key, *extra)[
+                    4
+                ]["loss"]
             ),
             "rollout": timed(rollout_only, env_state, obs, key),
             "env": timed(env_only, env_state, key),
@@ -668,6 +801,16 @@ class Trainer:
             self.train_state, self.env_state, self.obs = self._shard_fn(
                 self.train_state, self.env_state, self.obs
             )
+        if self._scenario_schedule is not None:
+            # Re-enter the schedule where the run left off — every rollout
+            # advances num_timesteps by exactly n_steps * num_envs, so the
+            # global rollout index is recoverable without extra checkpoint
+            # state (restarting at 0 would silently replay the severity
+            # ramp from the first stage).
+            self._scenario_rollouts = self.num_timesteps // (
+                self.ppo.n_steps * self.num_envs
+            )
+            self._resample_scenario_params()
         print(f"[trainer] resumed from {path} at {self.num_timesteps} steps")
 
     def _try_resume_multihost(self) -> None:

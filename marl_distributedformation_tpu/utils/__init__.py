@@ -6,7 +6,9 @@ from marl_distributedformation_tpu.utils.config import (  # noqa: F401
     env_params_from_config,
     load_config,
     repo_root,
+    scenario_schedule_from_config,
     setup_platform,
+    validate_override_keys,
 )
 from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
     broadcast_restore,

@@ -150,6 +150,60 @@ def repo_root() -> Path:
     return Path(__file__).resolve().parent.parent.parent
 
 
+def scenario_schedule_from_config(cfg: Config):
+    """Build the scenario-training schedule from the flat config
+    (``scenarios`` + ``scenario_severity`` keys, cfg/config.yaml) — None
+    when scenario training is off. Unknown scenario names fail fast here,
+    at config time, naming the registry entries."""
+    raw = cfg.get("scenarios")
+    if not raw:
+        return None
+    from marl_distributedformation_tpu.scenarios import schedule_from_cfg
+
+    return schedule_from_cfg(
+        raw, default_severity=float(cfg.get("scenario_severity") or 0.0)
+    )
+
+
+def validate_override_keys(
+    overrides: Iterable[str],
+    extra_keys: Iterable[str] = (),
+    config_path: str = "cfg/config.yaml",
+) -> None:
+    """Fail fast on mistyped CLI override keys (read-only entry points).
+
+    ``train.py`` keeps hydra's struct-less tolerance (experimental knobs
+    ride along in the config snapshot), but evaluation entry points have
+    no snapshot to expose the typo — an unknown key silently evaluates
+    the default (e.g. the clean env), which is exactly the failure mode
+    this guards. Valid keys = the YAML defaults + ``extra_keys``; dotted
+    overrides validate their top-level segment."""
+    path = Path(config_path)
+    if not path.is_absolute() and not path.exists():
+        path = repo_root() / config_path
+    with open(path) as f:
+        known = set(yaml.safe_load(f) or {})
+    # Every EnvParams field is honored by env_params_from_config even when
+    # the YAML defaults omit it (e.g. max_steps) — all are valid overrides.
+    from marl_distributedformation_tpu.env import EnvParams
+
+    known |= {f.name for f in dataclasses.fields(EnvParams)}
+    known |= set(extra_keys)
+    for item in overrides:
+        if "=" not in item:
+            continue  # apply_overrides raises its own error for these
+        key = item.split("=", 1)[0].split(".")[0]
+        if key not in known:
+            import difflib
+
+            close = difflib.get_close_matches(key, known, n=1)
+            hint = f" (did you mean {close[0]!r}?)" if close else ""
+            raise SystemExit(
+                f"unknown config key {key!r}{hint}; valid keys: "
+                f"{', '.join(sorted(known))}"
+            )
+
+
 def env_params_from_config(cfg: Config):
     """Build ``EnvParams`` from the flat config, forwarding every knob —
     including ``share_reward_ratio``, which the reference silently drops

@@ -119,11 +119,33 @@ def main(argv=None) -> int:
         help="sample actions instead of the deterministic mode",
     )
     parser.add_argument(
+        "--scenario",
+        help="perturb smoke request observations with this registered "
+        "scenario's sensor-noise magnitudes (scenarios/registry.py)",
+    )
+    parser.add_argument(
+        "--scenario-severity",
+        type=float,
+        default=1.0,
+        help="severity scale for --scenario (default 1.0)",
+    )
+    parser.add_argument(
         "--watch",
         action="store_true",
         help="keep serving + hot-reloading until interrupted",
     )
     args = parser.parse_args(argv)
+
+    if args.scenario:
+        # Resolve against the registry BEFORE the expensive part
+        # (checkpoint load + engine warmup): a typo'd name exits cleanly
+        # naming the valid entries, like every other entry point.
+        from marl_distributedformation_tpu.scenarios import get_scenario
+
+        try:
+            get_scenario(args.scenario)
+        except ValueError as e:
+            raise SystemExit(str(e)) from e
 
     from marl_distributedformation_tpu.serving import (
         BucketedPolicyEngine,
@@ -203,6 +225,8 @@ def main(argv=None) -> int:
                     num_clients=args.clients,
                     deterministic=not args.stochastic,
                     registry=registry,
+                    scenario=args.scenario,
+                    scenario_severity=args.scenario_severity,
                 )
                 report["buckets"] = ",".join(str(b) for b in buckets)
                 print(json.dumps(report), flush=True)

@@ -50,6 +50,11 @@ def test_bench_emits_parseable_json_on_cpu(monkeypatch, capsys):
     assert rec["train_env_steps_per_sec"] > 0
     assert rec["knn_env_steps_per_sec"] > 0
     assert rec["knn_big_env_steps_per_sec"] > 0  # phase 4 emits too
+    # Scenario-engine phase (scenarios/): the 3-layer storm stack rate
+    # rides the same JSON so the perf trajectory captures the wrapper
+    # overhead.
+    assert rec["scenario_env_steps_per_sec"] > 0
+    assert rec["scenario_stack"] == "storm@1.0"
     assert rec["train_env_steps_per_sec_tuned_fused"] > 0
     assert rec["train_tuned_iters_per_dispatch"] >= 2
     assert "error" not in rec and "notes" not in rec

@@ -369,6 +369,60 @@ FIXTURES = [
             return jax.lax.scan(body, init=n0, xs=xs), out
         """,
     ),
+    (
+        "vmap-in-axes-arity",
+        """
+        import jax
+
+        def f(x, y):
+            return x + y
+
+        def run(a, b):
+            # signature drifted: f takes 2 args, the axes spec says 3
+            return jax.vmap(f, in_axes=(0, None, 0))(a, b, b)
+        """,
+        """
+        import jax, functools
+
+        def f(x, y, scale=1.0):
+            return (x + y) * scale
+
+        def g(x, y):
+            return x + y
+
+        g = functools.partial(g, y=1)  # rebound: arity untrustworthy
+
+        def run(a, b):
+            two = jax.vmap(f, in_axes=(0, None))(a, b)       # default ok
+            three = jax.vmap(f, in_axes=(0, None, None))(a, b, 2.0)
+            # wrapped targets change the effective arity: out of scope
+            part = jax.vmap(
+                functools.partial(f, scale=2.0), in_axes=(0, None)
+            )(a, b)
+            one = jax.vmap(g, in_axes=(0,))(a)  # rebound name: skipped
+            return two, three, part, one
+        """,
+    ),
+    (
+        "vmap-in-axes-arity",
+        """
+        import jax
+
+        def run(a, b, g):
+            # g is imported/opaque — but the immediate call disagrees
+            # with the axes tuple, which is checkable syntactically
+            return jax.vmap(g, in_axes=(0, 0))(a, b, b)
+        """,
+        """
+        import jax
+
+        def run(a, b, g):
+            mapped = jax.vmap(g, in_axes=(0, None))(a, b)
+            star = jax.vmap(g, in_axes=(0, None))(*[a, b, b])  # skipped
+            scalar = jax.vmap(g, in_axes=0)(a, b, b)  # int spec: skipped
+            return mapped, star, scalar
+        """,
+    ),
 ]
 
 

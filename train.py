@@ -20,6 +20,7 @@ from marl_distributedformation_tpu.utils import (
     env_params_from_config,
     load_config,
     repo_root,
+    scenario_schedule_from_config,
     setup_platform,
 )
 
@@ -156,6 +157,9 @@ def build_trainer(cfg) -> Trainer:
             "learning_rates is a population knob: set num_seeds to the "
             "number of rates (one member per rate)"
         )
+    # Fail-fast at config time: unknown scenario names raise here naming
+    # the registry entries (never a silent clean-env run).
+    scenario_schedule = scenario_schedule_from_config(cfg)
     if cfg.get("curriculum"):
         if num_seeds > 1 and learning_rates:
             raise SystemExit(
@@ -163,12 +167,24 @@ def build_trainer(cfg) -> Trainer:
                 "populations (candidate-seed selection trains at one "
                 "rate); drop one of the two"
             )
+        if scenario_schedule is not None:
+            raise SystemExit(
+                "scenarios do not compose with curriculum training yet "
+                "(the hetero step is not scenario-wrapped); drop one of "
+                "the two"
+            )
         return build_hetero_trainer(
             cfg, env_params, ppo, train_cfg, shard_fn, num_seeds
         )
     policy = cfg.get("policy", "mlp")
     model = build_model(cfg, env_params, policy)
     if num_seeds > 1:
+        if scenario_schedule is not None:
+            raise SystemExit(
+                "scenarios do not compose with num_seeds>1 population "
+                "sweeps yet (the vmapped sweep iteration is not "
+                "scenario-wrapped); drop one of the two"
+            )
         from marl_distributedformation_tpu.train import SweepTrainer
 
         return SweepTrainer(
@@ -181,7 +197,12 @@ def build_trainer(cfg) -> Trainer:
             learning_rates=learning_rates,
         )
     return Trainer(
-        env_params, ppo=ppo, config=train_cfg, model=model, shard_fn=shard_fn
+        env_params,
+        ppo=ppo,
+        config=train_cfg,
+        model=model,
+        shard_fn=shard_fn,
+        scenario_schedule=scenario_schedule,
     )
 
 
