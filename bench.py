@@ -22,6 +22,10 @@ Measures, inside one process and one JSON line:
 - ``scenario_env_steps_per_sec``: env stepping through the 3-layer
   "storm" disturbance stack (scenarios/) — the scenario engine's wrapper
   overhead vs the clean headline (``scenario_overhead_pct``).
+- ``serving_requests_per_sec_fleet`` / ``serving_fleet_p95_ms``: the
+  serving-side number — a 2-replica fleet (serving/fleet/) driven by the
+  mixed-size smoke storm on a forced 2-device CPU, measured in a
+  subprocess (the multi-device CPU flag must land before backend init).
 
 Hardened against the flaky axon tunnel (round-1 failure mode: the first
 device op hung for minutes and the round recorded nothing):
@@ -38,7 +42,8 @@ device op hung for minutes and the round recorded nothing):
 Env-var knobs: BENCH_M, BENCH_N, BENCH_CHUNK, BENCH_TRAIN_M, BENCH_KNN_M,
 BENCH_KNN_BIG_M, BENCH_KNN_BIG_N, BENCH_BUDGET_S, BENCH_PROBE_TIMEOUT_S,
 BENCH_FORCE_CPU=1, BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1,
-BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1.
+BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1, BENCH_SKIP_SERVING=1,
+BENCH_SERVING_DURATION_S.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -717,6 +722,69 @@ def main() -> None:
                     notes.append(f"fused train phase failed: {e!r}"[:200])
             else:
                 notes.append("fused train phase skipped: deadline")
+        # Phase 6 — serving fleet throughput: a 2-replica fleet
+        # (serving/fleet/) under the mixed-size smoke storm. Runs in a
+        # SUBPROCESS with a forced 2-device CPU backend — the
+        # multi-device flag must land before backend init, which this
+        # process's backend has long passed — and always on CPU: this
+        # is a host-path (routing + coalescing + dispatch) number, the
+        # layer the fleet adds; model FLOPs are noise at this size.
+        # First serving-side perf number in the trajectory.
+        if os.environ.get("BENCH_SKIP_SERVING") != "1":
+            if time.time() < deadline - 60:
+                try:
+                    serving_s = float(
+                        os.environ.get("BENCH_SERVING_DURATION_S", 2.0)
+                    )
+                    cmd = [
+                        sys.executable,
+                        os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "serve_policy.py",
+                        ),
+                        "--init-policy", "MLPActorCritic",
+                        "--obs-dim", "8",
+                        "--fleet", "--replicas", "2",
+                        "--smoke",
+                        "--duration", str(serving_s),
+                    ]
+                    env = dict(os.environ)
+                    env["JAX_PLATFORMS"] = "cpu"
+                    env["XLA_FLAGS"] = (
+                        env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                    ).strip()
+                    out = subprocess.run(
+                        cmd, capture_output=True, text=True,
+                        timeout=max(deadline - time.time(), 60),
+                        env=env,
+                    )
+                    if out.returncode != 0:
+                        raise RuntimeError(
+                            f"fleet smoke exited {out.returncode}: "
+                            + out.stderr[-200:]
+                        )
+                    rep = json.loads(out.stdout.strip().splitlines()[-1])
+                    result["serving_requests_per_sec_fleet"] = round(
+                        rep["requests_per_sec_fleet"], 1
+                    )
+                    result["serving_fleet_p95_ms"] = round(
+                        rep["latency_p95_ms"], 2
+                    )
+                    result["serving_fleet_replicas"] = 2
+                    result["serving_fleet_max_compiles_per_rung"] = rep[
+                        "max_compiles_per_rung"
+                    ]
+                    print(
+                        "[bench] serving fleet (2 replicas, CPU): "
+                        f"{rep['requests_per_sec_fleet']:,.0f} req/s, "
+                        f"p95 {rep['latency_p95_ms']:.1f} ms",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade, don't die
+                    notes.append(f"serving phase failed: {e!r}"[:200])
+            else:
+                notes.append("serving phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

@@ -13,6 +13,9 @@ from marl_distributedformation_tpu.analysis.rules.control_flow import (
 )
 from marl_distributedformation_tpu.analysis.rules.deprecated import DeprecatedApi
 from marl_distributedformation_tpu.analysis.rules.donation import MissingDonate
+from marl_distributedformation_tpu.analysis.rules.f64_promotion import (
+    ImplicitF64Promotion,
+)
 from marl_distributedformation_tpu.analysis.rules.host_sync import HostSyncInJit
 from marl_distributedformation_tpu.analysis.rules.numpy_use import NumpyInJit
 from marl_distributedformation_tpu.analysis.rules.printing import PrintInJit
@@ -35,6 +38,7 @@ RULES = (
     PrintInJit(),
     ScanCarryWeakType(),
     VmapInAxesArity(),
+    ImplicitF64Promotion(),
 )
 
 

@@ -1,0 +1,195 @@
+"""implicit-f64-promotion: float64 leaking into traced f32 math.
+
+This framework is an f32 shop (every env/model buffer is pinned
+``jnp.float32``), but Python's numeric tower and numpy's defaults are
+both 64-bit, and the two failure modes are mirror images:
+
+- with ``jax_enable_x64`` OFF (the default), an f64 constant fed into a
+  jitted function is silently truncated to f32 at the boundary — the
+  spelled precision is a lie;
+- with ``jax_enable_x64`` ON (debug sessions, parity harnesses — the
+  exact context where numerics are being scrutinized), the same
+  constant is honored and PROMOTES the whole downstream expression to
+  f64: 2x memory, a different numerical trajectory, and a retrace of
+  every consumer whose input dtype just changed — the budget-1
+  RetraceGuards turn that into a hard failure.
+
+Flagged inside traced scopes:
+
+1. **Explicit float64 spellings** — ``np.float64(...)`` /
+   ``jnp.float64(...)`` / ``np.double(...)`` constructor calls,
+   ``dtype=`` arguments naming float64 (``np.float64``, ``"float64"``,
+   ``"f8"``, or the builtin ``float``, which numpy reads as f64), and
+   ``.astype`` to any of those. These are hazards regardless of taint:
+   a trace-time f64 constant poisons whatever traced math later touches
+   it.
+2. **Host-f64 producers mixed with traced values** — a binary
+   expression with a traced operand on one side and, on the other, a
+   host numpy constructor that defaults to float64: ``np.array`` /
+   ``np.asarray`` / ``np.arange`` / ``np.linspace`` / ``np.full``
+   containing a float literal with no ``dtype=``, or ``np.ones`` /
+   ``np.zeros`` / ``np.empty`` with no ``dtype=`` (always f64). The fix
+   is one keyword: ``dtype=np.float32``.
+
+NOT flagged, deliberately: bare Python float literals in traced
+arithmetic (``x * 0.5``) — JAX types these WEAKLY, so they adopt the
+traced operand's dtype and promote nothing; demanding
+``jnp.float32(0.5)`` everywhere would be noise. (The scan-carry case,
+where weak literals do bite, is scan-carry-weak-type's beat.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+_F64_CTORS = frozenset(
+    {
+        "np.float64",
+        "numpy.float64",
+        "np.double",
+        "numpy.double",
+        "jnp.float64",
+        "jax.numpy.float64",
+    }
+)
+_F64_DTYPE_STRINGS = frozenset({"float64", "f8", "<f8", ">f8", "double"})
+# numpy constructors whose result dtype defaults to float64: always for
+# the shape-taking ones, and whenever a float literal is among the data
+# for the value-taking ones.
+_ALWAYS_F64_PRODUCERS = frozenset(
+    {"np.ones", "numpy.ones", "np.zeros", "numpy.zeros",
+     "np.empty", "numpy.empty"}
+)
+_FLOAT_DATA_F64_PRODUCERS = frozenset(
+    {"np.array", "numpy.array", "np.asarray", "numpy.asarray",
+     "np.arange", "numpy.arange", "np.linspace", "numpy.linspace",
+     "np.full", "numpy.full"}
+)
+
+
+def _names_f64(node: ast.AST) -> bool:
+    """Does this expression spell the float64 dtype? (name chain, string
+    alias, or the builtin ``float``, which numpy canonicalizes to f64)"""
+    name = dotted_name(node)
+    if name in _F64_CTORS or name == "float":
+        return True
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value in _F64_DTYPE_STRINGS
+    )
+
+
+def _has_float_literal(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Constant) and isinstance(n.value, float)
+        for n in ast.walk(node)
+    )
+
+
+def _dtype_keyword(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    return None
+
+
+def _f64_producer(node: ast.AST) -> Optional[str]:
+    """Name of the host numpy call under ``node`` that produces float64
+    by default (no ``dtype=`` and, for the value-taking constructors, a
+    float literal in the data), else None."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        fname = dotted_name(sub.func)
+        if not fname or _dtype_keyword(sub) is not None:
+            continue
+        if fname in _ALWAYS_F64_PRODUCERS:
+            return fname
+        if fname in _FLOAT_DATA_F64_PRODUCERS and any(
+            _has_float_literal(a) for a in sub.args
+        ):
+            return fname
+    return None
+
+
+class ImplicitF64Promotion(Rule):
+    name = "implicit-f64-promotion"
+    default_severity = "error"
+    description = (
+        "float64 reaching traced f32 math under jit — silently truncated "
+        "with x64 off, a promotion + retrace with x64 on; pin dtype=float32"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for root in ctx.traced_roots:
+            taint = ctx.taint_for(root)
+            seen: Set[int] = set()  # one report per offending node
+            for node in ast.walk(root):
+                hit = None
+                if isinstance(node, ast.Call):
+                    hit = self._explicit_f64(node)
+                elif isinstance(node, ast.BinOp):
+                    hit = self._mixed_producer(ctx, node, taint)
+                if hit and id(node) not in seen:
+                    seen.add(id(node))
+                    yield (node.lineno, node.col_offset, hit)
+
+    @staticmethod
+    def _explicit_f64(node: ast.Call) -> Optional[str]:
+        fname = dotted_name(node.func)
+        if fname in _F64_CTORS:
+            return (
+                f"{fname}(...) builds a float64 scalar inside a traced "
+                "scope — truncated with x64 off, promotes the traced "
+                "math (and retraces consumers) with x64 on; use "
+                "jnp.float32"
+            )
+        dtype = _dtype_keyword(node)
+        if dtype is not None and _names_f64(dtype):
+            return (
+                f"dtype={ast.unparse(dtype)} requests float64 inside a "
+                "traced scope — pin jnp.float32 (the builtin `float` "
+                "dtype means f64 to numpy)"
+            )
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and _names_f64(node.args[0])
+        ):
+            return (
+                f".astype({ast.unparse(node.args[0])}) casts to float64 "
+                "inside a traced scope — truncated with x64 off, a "
+                "promotion + retrace with x64 on"
+            )
+        return None
+
+    @staticmethod
+    def _mixed_producer(
+        ctx: ModuleContext, node: ast.BinOp, taint
+    ) -> Optional[str]:
+        for tainted_side, other in (
+            (node.left, node.right),
+            (node.right, node.left),
+        ):
+            if not ctx.expr_tainted(tainted_side, taint):
+                continue
+            if ctx.expr_tainted(other, taint):
+                continue  # both traced: dtypes already pinned upstream
+            producer = _f64_producer(other)
+            if producer:
+                return (
+                    f"{producer}(...) defaults to float64 and is mixed "
+                    "with a traced value — under jax_enable_x64 this "
+                    "promotes the whole expression (and retraces "
+                    "consumers); pass dtype=np.float32"
+                )
+        return None

@@ -24,13 +24,22 @@ is compiled:
   latency percentiles, swap count; emitted through
   ``utils.logging.MetricsLogger``.
 - :class:`~.client.ServingClient` — the in-process client (used by tests
-  and the ``scripts/serve_policy.py`` smoke benchmark).
+  and the ``scripts/serve_policy.py`` smoke benchmark), duck-typed over
+  one scheduler or a whole fleet router.
+- ``serving.fleet`` — the multi-replica layer: ``FleetRouter`` (one
+  replica per local device, queue-depth routing, circuit breaking +
+  failover), ``FleetReloadCoordinator`` (poll-once batch-barrier swap,
+  globally step-monotonic), ``FleetFrontend`` (stdlib HTTP/JSON),
+  ``FleetMetrics``, ``run_fleet_smoke``.
 
 Architecture, bucket-ladder sizing, backpressure semantics, and the
 hot-reload contract are documented in ``docs/serving.md``.
 """
 
-from marl_distributedformation_tpu.serving.client import ServingClient
+from marl_distributedformation_tpu.serving.client import (
+    ServingClient,
+    backoff_s,
+)
 from marl_distributedformation_tpu.serving.engine import (
     DEFAULT_BUCKETS,
     BucketedPolicyEngine,
@@ -55,5 +64,6 @@ __all__ = [
     "ServedResult",
     "ServingClient",
     "ServingMetrics",
+    "backoff_s",
     "run_smoke_benchmark",
 ]

@@ -5,12 +5,19 @@ written against ``compat.policy.LoadedPolicy.predict`` ports by changing
 one constructor. On top of the raw future API it adds the two behaviors
 every well-behaved caller needs:
 
-- **honor backpressure** — on :class:`BackpressureError` it sleeps the
-  server-priced ``retry_after_s`` and retries, up to ``max_retries``
-  times, instead of hammering a full queue;
+- **honor backpressure** — on :class:`BackpressureError` it sleeps a
+  capped-exponential backoff floored at the server-priced
+  ``retry_after_s`` and retries, up to ``max_retries`` times (opt-in —
+  ``max_retries=0`` surfaces every reject), instead of hammering a full
+  queue;
 - **bounded waiting** — the future wait is capped by the request's own
   timeout plus the retry budget, so a caller can never hang on a dead
   server.
+
+The client is duck-typed over its target: anything with ``submit`` /
+``default_timeout_s`` works, which is exactly the surface
+``MicroBatchScheduler`` and ``fleet.FleetRouter`` share — the same
+client code talks to one engine or a whole fleet.
 """
 
 from __future__ import annotations
@@ -22,17 +29,44 @@ import numpy as np
 
 from marl_distributedformation_tpu.serving.scheduler import (
     BackpressureError,
-    MicroBatchScheduler,
     ServedResult,
 )
 
 
+def backoff_s(
+    attempt: int,
+    retry_after_s: float,
+    base_s: float = 0.05,
+    cap_s: float = 2.0,
+) -> float:
+    """Capped-exponential backoff that honors the server's hint.
+
+    The exponential leg ``base_s * 2**attempt`` is capped at ``cap_s``
+    (a client must not end up sleeping minutes because it retried six
+    times); the server-priced ``retry_after_s`` is a FLOOR, never capped
+    — sleeping less than the server's own drain estimate guarantees
+    another reject, which helps nobody. The exponential leg is what
+    saves the server when its estimate is too optimistic: a queue that
+    keeps rejecting at a tiny ``retry_after_s`` still sees this client
+    back off harder every attempt.
+    """
+    return max(
+        float(retry_after_s), min(cap_s, base_s * (2.0 ** attempt))
+    )
+
+
 class ServingClient:
     def __init__(
-        self, scheduler: MicroBatchScheduler, max_retries: int = 3
+        self,
+        scheduler,
+        max_retries: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
     ) -> None:
         self.scheduler = scheduler
         self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
 
     def predict(
         self,
@@ -64,13 +98,23 @@ class ServingClient:
                 future = self.scheduler.submit(
                     obs, deterministic=deterministic, timeout_s=timeout_s
                 )
+                # Slack over the request's own deadline: the scheduler
+                # fails expired requests itself; this outer bound only
+                # covers a wedged worker. BackpressureError can ALSO
+                # arrive through the future (a fleet router failing a
+                # request over onto replicas that are all full) — it
+                # consumes retry budget exactly like a submit-time
+                # reject.
+                return future.result(timeout=wait_s + 5.0)
             except BackpressureError as e:
                 if attempt == self.max_retries:
                     raise
-                time.sleep(e.retry_after_s)
-                continue
-            # Slack over the request's own deadline: the scheduler fails
-            # expired requests itself; this outer bound only covers a
-            # wedged worker.
-            return future.result(timeout=wait_s + 5.0)
+                time.sleep(
+                    backoff_s(
+                        attempt,
+                        e.retry_after_s,
+                        self.backoff_base_s,
+                        self.backoff_cap_s,
+                    )
+                )
         raise AssertionError("unreachable")  # pragma: no cover

@@ -1,0 +1,63 @@
+"""Replica-managed serving fleet over the single-engine stack.
+
+One ``MicroBatchScheduler`` + ``BucketedPolicyEngine`` pair serves one
+device; this package scales that proven unit sideways, the Podracer way
+(arXiv:2104.06272): replicate the compiled program per device behind a
+thin host-side dispatch layer, and keep the network strictly outside
+the compiled path.
+
+- :class:`~.router.FleetRouter` — owns one replica per local device,
+  routes each request to the healthy replica with the lowest estimated
+  drain time, circuit-breaks replicas whose worker dies or whose
+  RetraceGuard trips (with transparent failover of their accepted
+  requests), and half-open-probes broken replicas back in.
+- :class:`~.reload.FleetReloadCoordinator` — polls the checkpoint
+  directory ONCE for the whole fleet and swaps every replica at a
+  fleet-wide batch barrier, so ``model_step`` in responses is globally
+  monotonic (reload.py's module docstring is the consistency story).
+- :class:`~.frontend.FleetFrontend` — stdlib-only HTTP/JSON frontend
+  above ``FleetRouter.submit``: ``model_step`` on every response,
+  ``429`` + ``Retry-After`` backpressure, load-balancer-shaped
+  ``/v1/health``.
+- :class:`~.metrics.FleetMetrics` — routed/rejected/failed-over/breaks
+  counters plus merged-latency percentiles and per-replica occupancy,
+  through the same ``MetricsLogger`` pipeline as everything else.
+- :func:`~.smoke.run_fleet_smoke` — mixed-size request storm across the
+  fleet with the acceptance receipts (compile counts per replica,
+  global step-monotonicity violations) in the report.
+
+Topology, failure modes, and the consistency model are documented in
+``docs/serving.md`` ("Fleet").
+"""
+
+from marl_distributedformation_tpu.serving.fleet.frontend import (
+    FleetFrontend,
+)
+from marl_distributedformation_tpu.serving.fleet.metrics import FleetMetrics
+from marl_distributedformation_tpu.serving.fleet.reload import (
+    FleetReloadCoordinator,
+    ReplicaRegistry,
+    fleet_from_checkpoint_dir,
+)
+from marl_distributedformation_tpu.serving.fleet.router import (
+    FleetRouter,
+    NoHealthyReplicas,
+    Replica,
+)
+from marl_distributedformation_tpu.serving.fleet.smoke import (
+    run_fleet_smoke,
+    warmup_fleet,
+)
+
+__all__ = [
+    "FleetFrontend",
+    "FleetMetrics",
+    "FleetReloadCoordinator",
+    "FleetRouter",
+    "NoHealthyReplicas",
+    "Replica",
+    "ReplicaRegistry",
+    "fleet_from_checkpoint_dir",
+    "run_fleet_smoke",
+    "warmup_fleet",
+]

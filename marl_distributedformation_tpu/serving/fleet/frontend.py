@@ -1,0 +1,222 @@
+"""Stdlib-only HTTP frontend: the network door, strictly above the fleet.
+
+The compiled path must never learn about sockets — the frontend's whole
+job is translating HTTP+JSON to ``FleetRouter.submit`` and the router's
+failure taxonomy to status codes. ``http.server.ThreadingHTTPServer``
+(one thread per connection, stdlib) is plenty: the per-request work here
+is JSON parsing and a future wait; throughput lives below, in the
+coalescing scheduler and the compiled engines, exactly where TF-Agents
+(arXiv:1709.02878) says it belongs.
+
+Protocol (all bodies JSON):
+
+- ``POST /v1/act`` with ``{"obs": [[...row...], ...],
+  "deterministic": true, "timeout_s": 5.0}`` →
+  ``200 {"actions": [...], "model_step": N, "replica": i,
+  "latency_s": x}``. ``model_step`` rides on every response — the
+  fleet's version-pinning contract, end to end.
+- Backpressure → ``429`` with ``{"error": "backpressure",
+  "retry_after_s": x}`` AND a standard ``Retry-After`` header (integer
+  ceiling), so both JSON-aware clients and off-the-shelf HTTP retry
+  middleware see the hint.
+- Whole fleet broken → ``503``; request deadline passed → ``504``;
+  malformed body/shape → ``400``. Unexpected server errors → ``500``
+  with the exception class name (no tracebacks over the wire).
+- ``GET /v1/health`` → ``200`` while any replica serves, ``503`` when
+  none does (load-balancer shaped). ``GET /v1/metrics`` → the
+  aggregated fleet snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+
+# On Python 3.10 (this project's floor) concurrent.futures.TimeoutError
+# is NOT the builtin TimeoutError (they merged in 3.11) — catching only
+# the builtin would turn a wedged-worker wait into a 500.
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import numpy as np
+
+from marl_distributedformation_tpu.serving.fleet.router import (
+    FleetRouter,
+    NoHealthyReplicas,
+)
+from marl_distributedformation_tpu.serving.scheduler import (
+    BackpressureError,
+    RequestTimeout,
+    SchedulerStopped,
+)
+
+MAX_BODY_BYTES = 64 * 1024 * 1024  # one request can't OOM the frontend
+
+
+def _make_handler(router: FleetRouter):
+    class _Handler(BaseHTTPRequestHandler):
+        # The default handler logs one stderr line per request — at
+        # serving rates that is an accidental hot-loop host sync of the
+        # logging kind. Observability lives in /v1/metrics instead.
+        def log_message(self, fmt: str, *args: Any) -> None:
+            pass
+
+        def _reply(
+            self,
+            status: int,
+            payload: dict,
+            retry_after_s: Optional[float] = None,
+        ) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if retry_after_s is not None:
+                self.send_header(
+                    "Retry-After", str(max(1, math.ceil(retry_after_s)))
+                )
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client gave up; nothing to salvage
+
+        # -- reads -------------------------------------------------------
+
+        def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path == "/v1/health":
+                healthy = router.healthy_replicas
+                self._reply(
+                    200 if healthy else 503,
+                    {
+                        "healthy_replicas": healthy,
+                        "replicas": len(router.replicas),
+                        "model_step": int(
+                            max(
+                                r.registry.active_step
+                                for r in router.replicas
+                            )
+                        ),
+                    },
+                )
+            elif self.path == "/v1/metrics":
+                self._reply(200, router.snapshot())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        # -- act ---------------------------------------------------------
+
+        def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path != "/v1/act":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if not 0 < length <= MAX_BODY_BYTES:
+                    raise ValueError(
+                        f"Content-Length must be in (0, {MAX_BODY_BYTES}]"
+                    )
+                req = json.loads(self.rfile.read(length))
+                obs = np.asarray(req["obs"], np.float32)
+                deterministic = bool(req.get("deterministic", True))
+                timeout_s = req.get("timeout_s")
+                if timeout_s is not None:
+                    timeout_s = float(timeout_s)
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                future = router.submit(
+                    obs, deterministic=deterministic, timeout_s=timeout_s
+                )
+                wait = (
+                    timeout_s
+                    if timeout_s is not None
+                    else router.default_timeout_s
+                )
+                # Failover can legitimately re-queue once; leave slack
+                # beyond the request's own deadline (the scheduler
+                # expires it itself) before declaring the server wedged.
+                result = future.result(timeout=wait + 10.0)
+            except BackpressureError as e:
+                self._reply(
+                    429,
+                    {
+                        "error": "backpressure",
+                        "retry_after_s": e.retry_after_s,
+                    },
+                    retry_after_s=e.retry_after_s,
+                )
+            except NoHealthyReplicas as e:
+                self._reply(503, {"error": str(e)})
+            except (RequestTimeout, TimeoutError, FutureTimeoutError) as e:
+                self._reply(504, {"error": f"deadline passed: {e}"})
+            except SchedulerStopped as e:
+                self._reply(503, {"error": str(e)})
+            except ValueError as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+            except Exception as e:  # noqa: BLE001 — no tracebacks on the wire
+                self._reply(500, {"error": type(e).__name__})
+            else:
+                self._reply(
+                    200,
+                    {
+                        "actions": np.asarray(result.actions).tolist(),
+                        "model_step": int(result.model_step),
+                        "replica": int(result.replica),
+                        "latency_s": round(result.latency_s, 6),
+                    },
+                )
+
+    return _Handler
+
+
+class FleetFrontend:
+    """Threaded HTTP server over a router; ``port=0`` binds ephemeral
+    (the bound port is ``self.port`` — tests and the CLI print it)."""
+
+    def __init__(
+        self,
+        router: FleetRouter,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.router = router
+        self.server = ThreadingHTTPServer(
+            (host, port), _make_handler(router)
+        )
+        self.server.daemon_threads = True
+        self.host = self.server.server_address[0]
+        self.port = self.server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "FleetFrontend":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self.server.serve_forever,
+            name="fleet-frontend",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self.server.shutdown()
+        self.server.server_close()
+        self._thread.join(timeout=10.0)
+        self._thread = None
+
+    def __enter__(self) -> "FleetFrontend":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

@@ -1,0 +1,111 @@
+"""Fleet observability: the aggregate view a multi-replica server needs.
+
+Per-replica ``ServingMetrics`` already exist (each scheduler owns one);
+what the fleet layer adds is the numbers that only make sense ABOVE the
+replicas:
+
+- ``fleet_routed_total`` / per-replica routed counts — routing skew is
+  the router's core behavior; a flat-lined replica under load means the
+  drain estimator or the health state is wrong.
+- ``fleet_failed_over_total`` — requests transparently re-routed off a
+  dying replica. Nonzero during an incident is the system WORKING;
+  nonzero in steady state means a replica is flapping.
+- ``fleet_rejected_total`` — fleet-level backpressure: every healthy
+  replica was full. This is the number capacity planning watches.
+- ``fleet_breaks_total`` / ``fleet_healthy_replicas`` — circuit-breaker
+  activity and the live serving width.
+- merged ``latency_p50/p95/p99_ms`` — computed over the raw latency
+  samples of every replica pooled together (averaging per-replica
+  percentiles is statistically meaningless).
+
+``snapshot(replicas)`` returns the flat ``{name: float}`` dict shape the
+rest of the repo logs through ``utils.logging.MetricsLogger``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence
+
+from marl_distributedformation_tpu.serving.metrics import ServingMetrics
+
+
+class FleetMetrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.routed_total = 0
+        self.rejected_total = 0
+        self.failed_over_total = 0
+        self.breaks_total = 0
+        self.probes_total = 0
+        self._routed_per_replica: Dict[int, int] = {}
+
+    # -- recording (router side) ----------------------------------------
+
+    def record_routed(self, replica: int) -> int:
+        """Returns the new fleet-wide routed count (the router uses it
+        to pace logger emission)."""
+        with self._lock:
+            self.routed_total += 1
+            self._routed_per_replica[replica] = (
+                self._routed_per_replica.get(replica, 0) + 1
+            )
+            return self.routed_total
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected_total += 1
+
+    def record_failover(self) -> None:
+        with self._lock:
+            self.failed_over_total += 1
+
+    def record_break(self) -> None:
+        with self._lock:
+            self.breaks_total += 1
+
+    def record_probe(self) -> None:
+        with self._lock:
+            self.probes_total += 1
+
+    # -- reading ---------------------------------------------------------
+
+    def routed_per_replica(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._routed_per_replica)
+
+    def snapshot(self, replicas: Sequence) -> Dict[str, float]:
+        """Flat float dict over the fleet counters plus every replica's
+        own metrics; ``replicas`` is the router's replica list (each
+        exposes ``.index``, ``.healthy``, ``.scheduler.metrics``)."""
+        with self._lock:
+            out: Dict[str, float] = {
+                "fleet_replicas": float(len(replicas)),
+                "fleet_routed_total": float(self.routed_total),
+                "fleet_rejected_total": float(self.rejected_total),
+                "fleet_failed_over_total": float(self.failed_over_total),
+                "fleet_breaks_total": float(self.breaks_total),
+                "fleet_probes_total": float(self.probes_total),
+            }
+            routed = dict(self._routed_per_replica)
+        merged: List[float] = []
+        healthy = 0
+        for r in replicas:
+            m = r.scheduler.metrics
+            snap = m.snapshot()
+            healthy += int(r.healthy)
+            merged.extend(m.latencies_snapshot())
+            out[f"replica{r.index}_routed"] = float(routed.get(r.index, 0))
+            out[f"replica{r.index}_requests"] = snap["requests"]
+            out[f"replica{r.index}_occupancy_pct"] = snap[
+                "batch_occupancy_pct"
+            ]
+            out[f"replica{r.index}_queue_depth"] = snap["queue_depth"]
+            out[f"replica{r.index}_healthy"] = float(r.healthy)
+        out["fleet_healthy_replicas"] = float(healthy)
+        ordered = sorted(merged)
+        pct = ServingMetrics._percentile
+        out["latency_p50_ms"] = 1e3 * pct(ordered, 0.50)
+        out["latency_p95_ms"] = 1e3 * pct(ordered, 0.95)
+        out["latency_p99_ms"] = 1e3 * pct(ordered, 0.99)
+        return out

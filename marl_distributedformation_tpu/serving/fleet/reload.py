@@ -1,0 +1,328 @@
+"""Fleet-wide coordinated hot reload: poll once, swap everywhere,
+globally step-monotonic.
+
+``ModelRegistry`` (serving/registry.py) solves hot reload for ONE
+engine: snapshot-per-batch plus a step-monotonic swap under a lock. A
+fleet of replicas re-raises the consistency question — if each replica
+polled and swapped independently, two things go wrong: N replicas pay N
+redundant restores per checkpoint, and (worse) a client hopping between
+replicas can observe ``model_step`` going BACKWARD: replica A swaps to
+step 200 and answers, then replica B — poll racing a slow restore —
+answers with step 100. The ROADMAP names the fix: "coordinator polls,
+broadcasts the step, hosts swap at a batch barrier".
+
+:class:`FleetReloadCoordinator` implements exactly that:
+
+1. **Poll once.** One watcher polls ``logs/{name}/`` via
+   ``latest_checkpoint``; one restore + one validation per new
+   checkpoint, regardless of fleet width.
+2. **Prepare.** The validated host tree is ``device_put`` onto every
+   replica's device BEFORE any replica is touched — no replica ever
+   stalls mid-swap waiting for a weight upload.
+3. **Commit at the fleet batch barrier.** Every replica's scheduler
+   holds its registry's ``batch_lock`` for the duration of each
+   dispatch (scheduler.py). The coordinator acquires ALL replica locks,
+   which can only succeed at a moment when zero batches are in flight
+   anywhere, flips every replica's ``(params, step)`` cell, and
+   releases. Consequence: every response resolved before the commit
+   carries the old step, every response dispatched after carries the
+   new one — ``model_step`` is globally monotonic in response order,
+   fleet-wide, with no pause longer than one in-flight batch.
+
+Failure containment mirrors the single-engine registry: a
+mismatched-architecture / drifted-dtype / foreign checkpoint is a
+recorded ``load_errors`` entry and the fleet keeps serving the old
+params; older/equal steps are ignored; broken replicas still receive
+the new params so a later revival serves the current step, never a
+stale one.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from pathlib import Path
+from typing import Any, Deque, Optional, Tuple
+
+from marl_distributedformation_tpu.utils.checkpoint import (
+    checkpoint_step,
+    latest_checkpoint,
+    restore_state_dict_partial,
+)
+
+
+class BatchBarrier:
+    """A dispatch lock with a coordinator-side gate.
+
+    The worker side is a plain context manager held across each dispatch
+    (``with registry.batch_lock:``). The subtlety is FAIRNESS: under
+    load a worker releases its lock and re-acquires it microseconds
+    later for the next batch, and CPython locks are not FIFO — a
+    coordinator blocked in ``acquire()`` can starve for seconds behind
+    that re-acquisition loop. So the coordinator first ``close()``s the
+    gate; workers park at the gate BEFORE contending the lock, and the
+    coordinator gets every lock within at most one in-flight batch.
+    ``open()`` releases the parked workers after the commit.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._open = threading.Event()
+        self._open.set()
+
+    # -- worker side (one dispatch) --------------------------------------
+
+    def __enter__(self) -> "BatchBarrier":
+        self._open.wait()
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._lock.release()
+
+    # -- coordinator side (fleet commit) ---------------------------------
+
+    def close(self) -> None:
+        self._open.clear()
+
+    def acquire(self, timeout: Optional[float] = None) -> bool:
+        return self._lock.acquire(
+            timeout=-1 if timeout is None else timeout
+        )
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def open(self) -> None:
+        self._open.set()
+
+
+class ReplicaRegistry:
+    """One replica's ``(params, step)`` cell plus its batch barrier.
+
+    The scheduler holds ``batch_lock`` across each dispatch and reads
+    :meth:`active` once per micro-batch; the coordinator writes via
+    :meth:`install` only while holding every replica's barrier.
+    ``active`` itself is lock-free — a single tuple attribute read is
+    atomic in CPython, and the worker already holds the barrier when it
+    snapshots (a locking ``active`` would self-deadlock)."""
+
+    def __init__(self, params: Any, step: int, device: Any = None) -> None:
+        self.device = device
+        self.batch_lock = BatchBarrier()
+        self.swap_count = 0
+        self._snapshot: Tuple[Any, int] = (params, step)
+
+    def active(self) -> Tuple[Any, int]:
+        return self._snapshot
+
+    @property
+    def active_step(self) -> int:
+        return self._snapshot[1]
+
+    def install(self, params: Any, step: int) -> None:
+        """Replace the serving snapshot. Caller holds ``batch_lock``."""
+        self._snapshot = (params, step)
+        self.swap_count += 1
+
+
+class FleetReloadCoordinator:
+    """Single poller + fleet-wide batch-barrier swap over a router.
+
+    Args:
+      log_dir: the ``logs/{name}/`` directory the trainer checkpoints to.
+      router: a started-or-not ``fleet.FleetRouter``; the coordinator
+        swaps through its replicas' :class:`ReplicaRegistry` cells.
+      poll_interval_s: cadence of the background watcher (``start()``);
+        ``refresh()`` may also be called directly.
+      commit_timeout_s: bound on waiting for any single replica's
+        barrier at commit time. A worker wedged inside a device dispatch
+        (a hung tunnel op) holds its lock indefinitely; without the
+        bound, one wedged replica would park the WHOLE fleet behind
+        closed gates. On timeout the commit aborts cleanly — locks
+        released, gates reopened, a recorded ``load_errors`` entry —
+        and every replica keeps serving the old step (never a partial
+        swap); the next poll retries.
+    """
+
+    def __init__(
+        self,
+        log_dir: str | Path,
+        router: Any,
+        poll_interval_s: float = 2.0,
+        max_recorded_errors: int = 32,
+        commit_timeout_s: float = 30.0,
+    ) -> None:
+        self.log_dir = Path(log_dir)
+        self.router = router
+        self.poll_interval_s = poll_interval_s
+        self.commit_timeout_s = commit_timeout_s
+        self.swap_count = 0
+        self.load_errors: Deque[Tuple[str, str]] = deque(
+            maxlen=max_recorded_errors
+        )
+        # The fleet step starts at the newest step any replica already
+        # serves (the router seeds every replica identically).
+        self._fleet_step = max(
+            r.registry.active_step for r in router.replicas
+        )
+        self._refresh_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def fleet_step(self) -> int:
+        """The step every post-commit dispatch serves."""
+        return self._fleet_step
+
+    # -- reload ---------------------------------------------------------
+
+    def refresh(self) -> bool:
+        """Check the directory once; coordinated-swap if a newer
+        checkpoint landed. Returns True on swap. Load failures keep the
+        old params serving fleet-wide and are recorded."""
+        with self._refresh_lock:
+            path = latest_checkpoint(self.log_dir)
+            if path is None:
+                return False
+            step = checkpoint_step(path)
+            if step <= self._fleet_step:
+                return False
+            try:
+                restored = self._load_validated(path)
+            except Exception as e:  # noqa: BLE001 — serving must not die
+                self.load_errors.append((str(path), repr(e)))
+                return False
+            import jax
+
+            # Prepare: one host->device upload per replica, all before
+            # the barrier — the commit window stays lock-acquisition
+            # plus pointer flips, never a weight transfer.
+            staged = [
+                (r, jax.device_put(restored, r.registry.device))
+                for r in self.router.replicas
+            ]
+            barriers = [r.registry.batch_lock for r, _ in staged]
+            held = []
+            try:
+                # Close every gate FIRST: workers finish their current
+                # batch and park instead of re-contending their lock, so
+                # the acquisitions below complete within one in-flight
+                # batch (BatchBarrier's fairness note). Workers only
+                # ever hold their own lock — no cycle to deadlock on.
+                # With all locks held, zero batches are in flight
+                # fleet-wide: the commit point. The per-barrier timeout
+                # bounds a wedged replica (hung device op holding its
+                # lock): abort the WHOLE commit rather than park the
+                # fleet or swap partially — the finally reopens every
+                # gate and the old step keeps serving everywhere.
+                for b in barriers:
+                    b.close()
+                for i, b in enumerate(barriers):
+                    if not b.acquire(timeout=self.commit_timeout_s):
+                        self.load_errors.append(
+                            (
+                                str(path),
+                                f"commit aborted: replica {i} barrier "
+                                f"not acquired in {self.commit_timeout_s}"
+                                "s (wedged dispatch?); old step keeps "
+                                "serving fleet-wide",
+                            )
+                        )
+                        return False
+                    held.append(b)
+                for r, params in staged:
+                    r.registry.install(params, step)
+                self._fleet_step = step
+                self.swap_count += 1
+            finally:
+                for b in reversed(held):
+                    b.release()
+                for b in barriers:
+                    b.open()
+            return True
+
+    def _load_validated(self, path: Path) -> Any:
+        """One restore + validation for the whole fleet, against replica
+        0's live tree (all replicas serve the same architecture) — the
+        same template validation ``ModelRegistry.refresh`` performs."""
+        from marl_distributedformation_tpu.compat.policy import (
+            load_checkpoint_raw,
+        )
+
+        raw = load_checkpoint_raw(path)
+        want = type(self.router.policy.model).__name__
+        got = raw.get("policy", want)
+        if got != want:
+            raise ValueError(
+                f"checkpoint {path} was trained with policy {got!r}; "
+                f"this fleet serves {want!r}"
+            )
+        template = {"params": self.router.replicas[0].registry.active()[0]}
+        return restore_state_dict_partial(
+            raw, template, origin=str(path)
+        )["params"]
+
+    # -- background watcher ---------------------------------------------
+
+    def start(self) -> "FleetReloadCoordinator":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch, name="fleet-reload-coordinator", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        self._thread = None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.refresh()
+
+    def __enter__(self) -> "FleetReloadCoordinator":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def fleet_from_checkpoint_dir(
+    log_dir: str | Path,
+    env_params: Any = None,
+    act_dim: int = 2,
+    poll_interval_s: float = 2.0,
+    **router_kwargs: Any,
+):
+    """Build a ``(FleetRouter, FleetReloadCoordinator)`` pair serving the
+    newest checkpoint under ``log_dir`` — the fleet twin of constructing
+    a ``ModelRegistry`` from a directory. Router kwargs (``buckets``,
+    ``num_replicas``, ``window_ms``, …) pass through."""
+    from marl_distributedformation_tpu.compat.policy import LoadedPolicy
+    from marl_distributedformation_tpu.serving.fleet.router import (
+        FleetRouter,
+    )
+
+    log_dir = Path(log_dir)
+    path = latest_checkpoint(log_dir)
+    if path is None:
+        raise FileNotFoundError(
+            f"no rl_model_*_steps.msgpack checkpoint under {log_dir} "
+            "to serve"
+        )
+    policy = LoadedPolicy.from_checkpoint(
+        path, act_dim=act_dim, env_params=env_params
+    )
+    router = FleetRouter(
+        policy, initial_step=checkpoint_step(path), **router_kwargs
+    )
+    coordinator = FleetReloadCoordinator(
+        log_dir, router, poll_interval_s=poll_interval_s
+    )
+    return router, coordinator

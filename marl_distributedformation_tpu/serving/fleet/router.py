@@ -1,0 +1,404 @@
+"""FleetRouter: N compiled engines behind one submit surface.
+
+Podracer (arXiv:2104.06272) scales TPU-native RL by replicating ONE
+compiled program across devices behind a thin host-side dispatch layer;
+this module is that layer for serving. Each replica is the whole proven
+single-engine stack — ``BucketedPolicyEngine`` compiled against one
+device plus its own ``MicroBatchScheduler`` worker thread — and the
+router only does the three things a replica cannot do for itself:
+
+- **Route.** Every request goes to the healthy replica with the lowest
+  estimated drain time (queue depth x recent mean batch wall-clock —
+  the quantity ``retry_after_s`` is already priced in). Joining the
+  shortest *time* queue, not the shortest *length* queue, is what keeps
+  a replica with a slow device from accumulating a latency tail.
+- **Degrade.** A replica whose worker dies or whose budget-1
+  RetraceGuard trips is circuit-broken: marked unhealthy, its queued
+  requests transparently failed over to surviving replicas (bounded by
+  ``max_failovers`` hops and the request's own deadline), and
+  periodically re-probed (half-open: one routed request is the probe; a
+  still-broken replica fails it over again and re-breaks). The fleet
+  keeps serving at reduced width instead of dying.
+- **Reject honestly.** Only when EVERY healthy replica rejects does the
+  router raise fleet-level :class:`BackpressureError`, carrying the
+  smallest ``retry_after_s`` any replica quoted — same contract as the
+  single scheduler, so ``ServingClient`` works unchanged over a fleet.
+
+Device placement is by params residency: each replica's weights are
+``device_put`` onto its device and jit places each replica's compiled
+programs there — no per-call device juggling, no sharding machinery in
+the request path. The compiled path itself is untouched: the router is
+strictly host-side, exactly the layer TF-Agents (arXiv:1709.02878)
+identifies as where batched-inference throughput is won.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from marl_distributedformation_tpu.analysis.guards import RetraceError
+from marl_distributedformation_tpu.serving.engine import (
+    DEFAULT_BUCKETS,
+    BucketedPolicyEngine,
+)
+from marl_distributedformation_tpu.serving.fleet.metrics import FleetMetrics
+from marl_distributedformation_tpu.serving.fleet.reload import ReplicaRegistry
+from marl_distributedformation_tpu.serving.scheduler import (
+    BackpressureError,
+    MicroBatchScheduler,
+    SchedulerStopped,
+)
+
+
+class NoHealthyReplicas(RuntimeError):
+    """Every replica is circuit-broken: the fleet is down, not busy."""
+
+
+# Exceptions that indict the REPLICA, not the request: the router breaks
+# the circuit and fails the request over. Everything else (RequestTimeout,
+# a ValueError for malformed rows) is the caller's own outcome and
+# propagates untouched — failing over a malformed request would just
+# poison a second replica's dispatch.
+_REPLICA_FAULTS = (SchedulerStopped, RetraceError)
+
+
+@dataclasses.dataclass
+class Replica:
+    """One device's serving stack plus its circuit-breaker state."""
+
+    index: int
+    device: Any
+    engine: BucketedPolicyEngine
+    scheduler: MicroBatchScheduler
+    registry: ReplicaRegistry
+    healthy: bool = True
+    broken_at: float = 0.0
+    break_reason: str = ""
+
+
+class FleetRouter:
+    """Queue-depth routing + circuit breaking over per-device replicas.
+
+    Args:
+      policy: a ``compat.policy.LoadedPolicy`` (shared model definition;
+        each replica gets its own device-resident copy of the params).
+      devices: devices to replicate over; default ``jax.local_devices()``.
+      num_replicas: replica count; default one per device. More replicas
+        than devices cycle over them (useful for tests; on hardware one
+        replica per device is the shape that makes sense).
+      max_failovers: how many times one accepted request may be re-routed
+        off a broken replica before its failure surfaces to the caller.
+      probe_interval_s: how long a broken replica stays out of rotation
+        before a half-open probe readmits it.
+      initial_step: ``model_step`` the seeded params report (the fleet
+        builder passes the checkpoint's step).
+      logger: optional ``MetricsLogger``; the aggregated fleet snapshot
+        is emitted every ``emit_every`` routed requests.
+    """
+
+    def __init__(
+        self,
+        policy: Any,
+        devices: Optional[Sequence[Any]] = None,
+        num_replicas: Optional[int] = None,
+        buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
+        window_ms: float = 2.0,
+        max_queue: int = 256,
+        default_timeout_s: float = 10.0,
+        seed: int = 0,
+        max_failovers: int = 1,
+        probe_interval_s: float = 1.0,
+        initial_step: int = 0,
+        metrics: Optional[FleetMetrics] = None,
+        logger: Any = None,
+        emit_every: int = 200,
+    ) -> None:
+        import jax
+
+        devs = list(devices) if devices is not None else jax.local_devices()
+        if not devs:
+            raise ValueError("need at least one device to build a fleet")
+        n = len(devs) if num_replicas is None else int(num_replicas)
+        if n < 1:
+            raise ValueError(f"need at least one replica, got {n}")
+        self.policy = policy
+        self.default_timeout_s = default_timeout_s
+        self.max_failovers = max_failovers
+        self.probe_interval_s = probe_interval_s
+        self.metrics = metrics or FleetMetrics()
+        self.logger = logger
+        self.emit_every = emit_every
+        self._health_lock = threading.Lock()
+        self._stopping = False
+        self.replicas: List[Replica] = []
+        for i in range(n):
+            dev = devs[i % len(devs)]
+            registry = ReplicaRegistry(
+                jax.device_put(policy.params, dev),
+                step=initial_step,
+                device=dev,
+            )
+            engine = BucketedPolicyEngine(
+                policy, buckets=buckets, seed=seed + i
+            )
+            scheduler = MicroBatchScheduler(
+                engine,
+                registry=registry,
+                max_queue=max_queue,
+                window_ms=window_ms,
+                default_timeout_s=default_timeout_s,
+            )
+            self.replicas.append(
+                Replica(
+                    index=i,
+                    device=dev,
+                    engine=engine,
+                    scheduler=scheduler,
+                    registry=registry,
+                )
+            )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        self._stopping = False
+        for r in self.replicas:
+            r.scheduler.start()
+        return self
+
+    def stop(self) -> None:
+        # Flag first: the drain of each scheduler fails its queued
+        # futures with SchedulerStopped, and the failover callbacks must
+        # not bounce those between replicas that are also shutting down.
+        self._stopping = True
+        for r in self.replicas:
+            r.scheduler.stop()
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- client side -----------------------------------------------------
+
+    def submit(
+        self,
+        obs: np.ndarray,
+        deterministic: bool = True,
+        timeout_s: Optional[float] = None,
+        on_result: Optional[Any] = None,
+    ) -> Future:
+        """Route one request; returns a future resolving to
+        ``ServedResult`` (with ``.replica`` set). Raises
+        :class:`BackpressureError` when every healthy replica is full,
+        :class:`NoHealthyReplicas` when the whole fleet is broken.
+
+        ``on_result(result)``, if given, runs at resolution time INSIDE
+        the serving replica's batch-barrier region — i.e. strictly
+        before the reload coordinator can commit a swap. That makes it
+        the race-free place to observe fleet-wide response completion
+        order (the smoke storm's step-monotonicity witness); an
+        observer that waits on the returned future instead can be
+        preempted between resolution and its own bookkeeping. Keep it
+        cheap: it runs on the dispatch path."""
+        timeout = (
+            self.default_timeout_s if timeout_s is None else timeout_s
+        )
+        deadline = time.perf_counter() + timeout
+        outer: Future = Future()
+        replica, inner = self._route(obs, deterministic, timeout_s, set())
+        self._chain(
+            replica, inner, outer, obs, deterministic, timeout_s,
+            hops=0, tried={replica.index}, deadline=deadline,
+            on_result=on_result,
+        )
+        return outer
+
+    # -- routing ---------------------------------------------------------
+
+    def _route(
+        self,
+        obs: np.ndarray,
+        deterministic: bool,
+        timeout_s: Optional[float],
+        tried: Set[int],
+    ) -> Tuple[Replica, Future]:
+        """Submit to the best healthy replica not in ``tried``; walk down
+        the drain-time ordering past individually-full replicas."""
+        self._probe_broken()
+        candidates = sorted(
+            (
+                r
+                for r in self.replicas
+                if r.healthy and r.index not in tried
+            ),
+            key=lambda r: r.scheduler.estimated_drain_s(),
+        )
+        rejections: List[BackpressureError] = []
+        for r in candidates:
+            if not r.scheduler.alive:
+                self._break(r, "worker thread dead at routing time")
+                continue
+            try:
+                inner = r.scheduler.submit(
+                    obs, deterministic=deterministic, timeout_s=timeout_s
+                )
+                return r, inner
+            except BackpressureError as e:
+                rejections.append(e)
+            except ValueError:
+                raise  # malformed request: the caller's problem, as-is
+            except RuntimeError as e:
+                # "scheduler not started" / racing a concurrent stop().
+                self._break(r, f"submit failed: {e!r}")
+        if rejections:
+            self.metrics.record_rejected()
+            raise BackpressureError(
+                min(e.retry_after_s for e in rejections)
+            )
+        raise NoHealthyReplicas(
+            f"all {len(self.replicas)} replicas are circuit-broken: "
+            + "; ".join(
+                f"replica{r.index}: {r.break_reason or 'unknown'}"
+                for r in self.replicas
+                if not r.healthy
+            )
+        )
+
+    def _chain(
+        self,
+        replica: Replica,
+        inner: Future,
+        outer: Future,
+        obs: np.ndarray,
+        deterministic: bool,
+        timeout_s: Optional[float],
+        hops: int,
+        tried: Set[int],
+        deadline: float,
+        on_result: Optional[Any] = None,
+    ) -> None:
+        """Resolve ``outer`` from ``inner``, failing over replica faults
+        onto a fresh replica while the hop budget and deadline allow."""
+
+        def _done(fut: Future) -> None:
+            exc = fut.exception()
+            if exc is None:
+                result = dataclasses.replace(
+                    fut.result(), replica=replica.index
+                )
+                count = self.metrics.record_routed(replica.index)
+                if on_result is not None:
+                    on_result(result)
+                outer.set_result(result)
+                if (
+                    self.logger is not None
+                    and count % self.emit_every == 0
+                ):
+                    # Off the dispatch path: this callback runs inside
+                    # the replica's batch-barrier region, and snapshot()
+                    # walks every replica's latency window — doing that
+                    # under the lock would stretch every batch AND the
+                    # coordinator's commit wait.
+                    threading.Thread(
+                        target=self._emit_snapshot,
+                        args=(count,),
+                        name="fleet-metrics-emit",
+                        daemon=True,
+                    ).start()
+                return
+            if isinstance(exc, _REPLICA_FAULTS) and not self._stopping:
+                self._break(replica, repr(exc))
+                if (
+                    hops < self.max_failovers
+                    and time.perf_counter() < deadline
+                ):
+                    try:
+                        nxt, nfut = self._route(
+                            obs, deterministic, timeout_s, tried
+                        )
+                    except Exception as routing_exc:  # noqa: BLE001
+                        outer.set_exception(routing_exc)
+                        return
+                    self.metrics.record_failover()
+                    self._chain(
+                        nxt, nfut, outer, obs, deterministic, timeout_s,
+                        hops + 1, tried | {nxt.index}, deadline,
+                        on_result=on_result,
+                    )
+                    return
+            outer.set_exception(exc)
+
+        inner.add_done_callback(_done)
+
+    def _emit_snapshot(self, count: int) -> None:
+        try:
+            self.logger.log(self.snapshot(), step=count)
+        except Exception:  # noqa: BLE001 — observability never kills serving
+            pass
+
+    # -- health ----------------------------------------------------------
+
+    def _break(self, replica: Replica, reason: str) -> None:
+        with self._health_lock:
+            if not replica.healthy:
+                return
+            replica.healthy = False
+            replica.broken_at = time.monotonic()
+            replica.break_reason = reason
+        self.metrics.record_break()
+
+    def _probe_broken(self) -> None:
+        """Half-open probing on the routing path: a broken replica whose
+        probe interval elapsed and whose worker is alive is readmitted;
+        its next routed request is the real probe (failure re-breaks
+        it). A dead worker can never be readmitted."""
+        now = time.monotonic()
+        for r in self.replicas:
+            if r.healthy or now - r.broken_at < self.probe_interval_s:
+                continue
+            self.metrics.record_probe()
+            if r.scheduler.alive:
+                with self._health_lock:
+                    if not r.healthy:
+                        r.healthy = True
+                        r.break_reason = ""
+            else:
+                r.broken_at = now  # still dead; re-check next interval
+
+    def kill_replica(self, index: int, reason: str = "killed") -> None:
+        """Stop one replica's worker (chaos hook, used by tests and the
+        smoke storm). Its queued requests fail with ``SchedulerStopped``
+        and the failover path re-routes them to surviving replicas."""
+        replica = self.replicas[index]
+        self._break(replica, reason)
+        replica.scheduler.stop()
+
+    @property
+    def healthy_replicas(self) -> int:
+        return sum(1 for r in self.replicas if r.healthy)
+
+    # -- observability ---------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        """Aggregated fleet metrics (fleet/metrics.py) plus the newest
+        step any replica serves."""
+        snap = self.metrics.snapshot(self.replicas)
+        snap["model_step"] = float(
+            max(r.registry.active_step for r in self.replicas)
+        )
+        return snap
+
+    def compile_counts(self) -> Dict[int, Dict[int, int]]:
+        """Per-replica per-rung trace counts — the fleet-wide
+        compile-once receipt (every value must be <= 1)."""
+        return {
+            r.index: r.engine.compile_counts() for r in self.replicas
+        }

@@ -75,6 +75,14 @@ class ServingMetrics:
 
     # -- reading ---------------------------------------------------------
 
+    def latencies_snapshot(self) -> List[float]:
+        """Copy of the recent latency window (seconds). The fleet
+        aggregator merges these across replicas so fleet percentiles are
+        computed over raw samples, not averaged per-replica percentiles
+        (averaging percentiles is statistically meaningless)."""
+        with self._lock:
+            return list(self._latencies)
+
     def mean_batch_seconds(self, default: float = 1e-3) -> float:
         """Recent mean wall-clock per dispatched batch — the unit the
         scheduler prices ``retry_after_s`` in."""

@@ -29,6 +29,7 @@ produced it (``ServedResult.model_step``).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue
 import threading
@@ -67,6 +68,7 @@ class ServedResult:
     actions: np.ndarray
     model_step: int  # checkpoint step of the params that answered
     latency_s: float  # enqueue -> result
+    replica: int = -1  # fleet replica index (-1: single-engine serving)
 
 
 @dataclasses.dataclass
@@ -119,6 +121,7 @@ class MicroBatchScheduler:
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._busy = False  # worker mid-dispatch (drain estimation)
 
     # -- client side -----------------------------------------------------
 
@@ -164,8 +167,26 @@ class MicroBatchScheduler:
     def retry_after_s(self) -> float:
         """Backoff hint: the window plus roughly how long the current
         backlog takes to drain at the recent batch rate."""
-        backlog = self._queue.qsize()
-        return self.window_s + backlog * self.metrics.mean_batch_seconds()
+        return self.window_s + self.estimated_drain_s()
+
+    def estimated_drain_s(self) -> float:
+        """Roughly how long the current backlog takes to drain at the
+        recent batch rate — the number a fleet router routes on. The
+        in-flight batch counts: a worker stuck in a slow dispatch with
+        an empty queue is NOT an idle replica."""
+        backlog = self._queue.qsize() + (1 if self._busy else 0)
+        return backlog * self.metrics.mean_batch_seconds()
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    @property
+    def alive(self) -> bool:
+        """True while the worker thread is serving. A stopped (or
+        crashed-at-interpreter-teardown) worker makes every queued future
+        dead weight — the router's liveness probe checks this."""
+        return self._thread is not None and self._thread.is_alive()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -230,6 +251,7 @@ class MicroBatchScheduler:
                 batch.append(nxt)
                 rows += nxt.obs.shape[0]
             try:
+                self._busy = True
                 self._dispatch(batch)
             except Exception as e:  # noqa: BLE001 — the worker must survive
                 # Backstop: _dispatch_group already contains engine
@@ -238,6 +260,8 @@ class MicroBatchScheduler:
                 for req in batch:
                     if not req.future.done():
                         req.future.set_exception(e)
+            finally:
+                self._busy = False
 
     def _dispatch(self, batch: List[_Request]) -> None:
         now = time.perf_counter()
@@ -263,8 +287,15 @@ class MicroBatchScheduler:
         groups: dict = {}
         for r in live:
             groups.setdefault((r.deterministic, r.obs.shape[1:]), []).append(r)
-        for (flag, _), group in groups.items():
-            self._dispatch_group(group, flag)
+        # Batch barrier: a registry may expose ``batch_lock`` (the fleet
+        # replica registry does), held for the whole dispatch. A reload
+        # coordinator that acquires EVERY replica's lock before flipping
+        # any pointer gets a fleet-wide point in time with zero batches
+        # in flight — the foundation of globally step-monotonic swaps.
+        lock = getattr(self.registry, "batch_lock", None)
+        with lock if lock is not None else contextlib.nullcontext():
+            for (flag, _), group in groups.items():
+                self._dispatch_group(group, flag)
 
     def _dispatch_group(self, group: List[_Request], flag: bool) -> None:
         if self.registry is not None:

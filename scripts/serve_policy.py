@@ -12,12 +12,25 @@ Usage:
     # no checkpoint yet? serve a freshly initialized policy
     python scripts/serve_policy.py --init-policy MLPActorCritic --obs-dim 8 --smoke
 
+    # multi-replica fleet: one engine per local device, coordinated
+    # hot reload, HTTP frontend on --port (0 = ephemeral, printed)
+    python scripts/serve_policy.py logs/run1 --fleet --port 8100
+    python scripts/serve_policy.py logs/run1 --fleet --replicas 2 --smoke
+
+    # 2-replica fleet smoke on a forced multi-device CPU (what bench.py
+    # records as serving_requests_per_sec_fleet)
+    XLA_FLAGS=--xla_force_host_platform_device_count=2 JAX_PLATFORMS=cpu \\
+        python scripts/serve_policy.py --init-policy MLPActorCritic \\
+        --obs-dim 8 --fleet --replicas 2 --smoke
+
 The server is the in-process stack from
 ``marl_distributedformation_tpu.serving`` (bucketed compiled engine,
 micro-batching scheduler, hot-reload registry — docs/serving.md); this
 CLI wires it to a checkpoint directory and drives it with a synthetic
 mixed-size load (``--smoke``) or leaves it serving + watching
-(``--watch``, the mode a real frontend would embed).
+(``--watch``, the mode a real frontend would embed). ``--fleet``
+replaces the single engine with ``serving.fleet`` (router + coordinated
+reload + optional HTTP frontend, docs/serving.md "Fleet").
 """
 
 from __future__ import annotations
@@ -67,6 +80,186 @@ def _infer_row_shape(policy) -> tuple:
     import numpy as np
 
     return (int(np.shape(kernel)[0]),)
+
+
+def _ensure_cpu_devices(n: int) -> None:
+    """Widen the CPU device pool to ``n`` for a --fleet run that asks
+    for more replicas than devices. Mirrors tests/conftest.py: the
+    backend may already be initialized (this image's sitecustomize
+    imports jax at interpreter start), in which case the config update
+    needs a backend reset first. On real accelerators this is a no-op —
+    you get the devices the hardware has."""
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        # Land the flag before the first backend init; if the backend
+        # already exists (sitecustomize), the reset below re-reads it.
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    if len(jax.local_devices()) >= n or jax.default_backend() != "cpu":
+        return
+    try:
+        jax.config.update("jax_num_cpu_devices", n)  # newer jax spelling
+    except (AttributeError, RuntimeError):
+        try:
+            import jax.extend.backend as jeb
+
+            jeb.clear_backends()  # re-init reads the XLA_FLAGS above
+        except Exception:  # noqa: BLE001 — widening is best-effort
+            pass
+    if len(jax.local_devices()) < n:
+        print(
+            f"[serve] warning: wanted {n} CPU devices, have "
+            f"{len(jax.local_devices())}; replicas will share devices",
+            file=sys.stderr,
+        )
+
+
+def _build_init_policy(args):
+    """A freshly initialized policy for --init-policy runs (shared by
+    the single-engine and --fleet paths — one construction recipe, so
+    the two can never drift)."""
+    if args.obs_dim is None:
+        raise SystemExit("--init-policy requires --obs-dim")
+    import jax
+    import jax.numpy as jnp
+
+    from marl_distributedformation_tpu.compat.policy import (
+        POLICY_REGISTRY,
+        LoadedPolicy,
+    )
+
+    if args.init_policy not in POLICY_REGISTRY:
+        raise SystemExit(
+            f"unknown policy {args.init_policy!r}; known: "
+            f"{sorted(POLICY_REGISTRY)}"
+        )
+    model = POLICY_REGISTRY[args.init_policy](act_dim=2)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, args.obs_dim))
+    )
+    return LoadedPolicy(dict(variables), policy=args.init_policy)
+
+
+def _run_fleet(args) -> int:
+    """The --fleet serving path: router + coordinated reload +
+    optional HTTP frontend (serving/fleet/, docs/serving.md "Fleet")."""
+    if args.replicas:
+        _ensure_cpu_devices(args.replicas)
+
+    from marl_distributedformation_tpu.serving.fleet import (
+        FleetFrontend,
+        FleetRouter,
+        fleet_from_checkpoint_dir,
+        run_fleet_smoke,
+    )
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    logger = None
+    coordinator = None
+    if args.init_policy:
+        policy = _build_init_policy(args)
+        router = FleetRouter(
+            policy,
+            num_replicas=args.replicas,
+            buckets=buckets,
+            window_ms=args.window_ms,
+            max_queue=args.queue,
+        )
+    elif args.log_dir:
+        from marl_distributedformation_tpu.utils.logging import MetricsLogger
+
+        logger = MetricsLogger(
+            Path(args.log_dir) / "serving", run_name="fleet"
+        )
+        router, coordinator = fleet_from_checkpoint_dir(
+            args.log_dir,
+            num_replicas=args.replicas,
+            buckets=buckets,
+            window_ms=args.window_ms,
+            max_queue=args.queue,
+            poll_interval_s=args.poll_s,
+            logger=logger,
+        )
+        policy = router.policy
+        print(
+            f"[serve] fleet serving {type(policy.model).__name__} from "
+            f"{args.log_dir} at step {coordinator.fleet_step}",
+            file=sys.stderr,
+        )
+    else:
+        raise SystemExit("need a log_dir or --init-policy (see --help)")
+
+    if args.obs_dim:
+        row_shape = (
+            (args.agents, args.obs_dim) if args.agents else (args.obs_dim,)
+        )
+    else:
+        row_shape = _infer_row_shape(policy)
+    devices = {str(r.device) for r in router.replicas}
+    print(
+        f"[serve] fleet: {len(router.replicas)} replicas over "
+        f"{len(devices)} devices, buckets {args.buckets}",
+        file=sys.stderr,
+    )
+
+    frontend = None
+    try:
+        router.start()
+        if coordinator is not None:
+            coordinator.start()
+        if args.port is not None:
+            frontend = FleetFrontend(router, port=args.port).start()
+            print(
+                f"[serve] fleet frontend listening on {frontend.url}",
+                file=sys.stderr,
+            )
+        if args.smoke or (args.port is None and not args.watch):
+            report = run_fleet_smoke(
+                router,
+                row_shape=row_shape,
+                duration_s=args.duration,
+                num_clients=args.clients,
+                deterministic=not args.stochastic,
+                coordinator=coordinator,
+            )
+            report["buckets"] = ",".join(str(b) for b in buckets)
+            report["replicas"] = float(len(router.replicas))
+            print(json.dumps(report), flush=True)
+            if report["client_requests_ok"] == 0:
+                print(
+                    "[serve] fleet smoke served 0 requests — failing",
+                    file=sys.stderr,
+                )
+                return 1
+        else:
+            print(
+                "[serve] fleet serving; Ctrl-C to stop", file=sys.stderr
+            )
+            while True:
+                time.sleep(10.0)
+                snap = router.snapshot()
+                print(
+                    f"[serve] step={snap['model_step']:.0f} "
+                    f"healthy={snap['fleet_healthy_replicas']:.0f}/"
+                    f"{len(router.replicas)} "
+                    f"routed={snap['fleet_routed_total']:.0f} "
+                    f"p95={snap['latency_p95_ms']:.1f}ms",
+                    file=sys.stderr,
+                )
+    except KeyboardInterrupt:
+        print("[serve] interrupted; shutting down", file=sys.stderr)
+    finally:
+        if frontend is not None:
+            frontend.stop()
+        if coordinator is not None:
+            coordinator.stop()
+        router.stop()
+        if logger is not None:
+            logger.close()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -134,7 +327,29 @@ def main(argv=None) -> int:
         action="store_true",
         help="keep serving + hot-reloading until interrupted",
     )
+    parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="serve a multi-replica fleet (serving.fleet): one "
+        "engine+scheduler per local device behind a load-aware router "
+        "with coordinated hot reload",
+    )
+    parser.add_argument(
+        "--replicas",
+        type=int,
+        help="fleet replica count (default: one per local device); on a "
+        "CPU backend the device pool is widened to match if needed",
+    )
+    parser.add_argument(
+        "--port",
+        type=int,
+        help="with --fleet: expose the stdlib HTTP frontend on this "
+        "port (0 = ephemeral; the bound port is printed to stderr)",
+    )
     args = parser.parse_args(argv)
+
+    if (args.port is not None or args.replicas is not None) and not args.fleet:
+        raise SystemExit("--port/--replicas require --fleet")
 
     if args.scenario:
         # Resolve against the registry BEFORE the expensive part
@@ -147,6 +362,14 @@ def main(argv=None) -> int:
         except ValueError as e:
             raise SystemExit(str(e)) from e
 
+    if args.fleet:
+        if args.scenario:
+            raise SystemExit(
+                "--scenario perturbs the single-engine smoke only; "
+                "run it without --fleet"
+            )
+        return _run_fleet(args)
+
     from marl_distributedformation_tpu.serving import (
         BucketedPolicyEngine,
         MicroBatchScheduler,
@@ -156,26 +379,7 @@ def main(argv=None) -> int:
 
     registry = None
     if args.init_policy:
-        if args.obs_dim is None:
-            raise SystemExit("--init-policy requires --obs-dim")
-        import jax
-        import jax.numpy as jnp
-
-        from marl_distributedformation_tpu.compat.policy import (
-            POLICY_REGISTRY,
-            LoadedPolicy,
-        )
-
-        if args.init_policy not in POLICY_REGISTRY:
-            raise SystemExit(
-                f"unknown policy {args.init_policy!r}; known: "
-                f"{sorted(POLICY_REGISTRY)}"
-            )
-        model = POLICY_REGISTRY[args.init_policy](act_dim=2)
-        variables = model.init(
-            jax.random.PRNGKey(0), jnp.zeros((1, args.obs_dim))
-        )
-        policy = LoadedPolicy(dict(variables), policy=args.init_policy)
+        policy = _build_init_policy(args)
     elif args.log_dir:
         registry = ModelRegistry(
             args.log_dir, poll_interval_s=args.poll_s

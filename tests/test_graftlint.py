@@ -404,6 +404,47 @@ FIXTURES = [
         """,
     ),
     (
+        "implicit-f64-promotion",
+        """
+        import jax, numpy as np
+
+        @jax.jit
+        def f(x):
+            scale = np.float64(0.5)          # f64 scalar at trace time
+            y = x * np.array([0.5, 1.5])     # host f64 mixed with traced
+            return (y * scale).astype(np.float64)
+        """,
+        """
+        import jax, jax.numpy as jnp, numpy as np
+
+        @jax.jit
+        def f(x):
+            y = x * 0.5                      # weak literal: adopts x's dtype
+            table = np.array([0.5, 1.5], dtype=np.float32)  # pinned
+            z = y + jnp.asarray(table)
+            counts = x + np.arange(4)        # int arange: not an f64 source
+            return z.astype(jnp.float32), counts
+        """,
+    ),
+    (
+        "implicit-f64-promotion",
+        """
+        import jax, jax.numpy as jnp, numpy as np
+
+        @jax.jit
+        def g(x):
+            grid = jnp.zeros((4,), dtype=float)  # builtin float == f64
+            return x + grid, x * np.linspace(0.0, 1.0, 4)
+        """,
+        """
+        import numpy as np
+
+        def host_report(arr):
+            # not traced: host-side f64 statistics are fine
+            return np.float64(arr).mean() + np.linspace(0.0, 1.0, 4)
+        """,
+    ),
+    (
         "vmap-in-axes-arity",
         """
         import jax
@@ -457,14 +498,17 @@ def test_package_is_clean_at_default_severity():
 
 
 def test_package_scan_covers_serving():
-    """The zero-violation pin must include the serving/ subsystem (a
-    future exclude entry or package move cannot silently drop it)."""
+    """The zero-violation pin must include the serving/ subsystem AND
+    its fleet/ subpackage (a future exclude entry or package move
+    cannot silently drop either)."""
     from marl_distributedformation_tpu.analysis import load_config
     from marl_distributedformation_tpu.analysis.linter import iter_python_files
 
     files = list(iter_python_files([PACKAGE], load_config(REPO), root=REPO))
     served = [f for f in files if "serving" in f.parts]
     assert len(served) >= 6, f"serving/ missing from the lint scan: {files}"
+    fleet = [f for f in served if "fleet" in f.parts]
+    assert len(fleet) >= 6, f"serving/fleet/ missing from the scan: {served}"
 
 
 # ---------------------------------------------------------------------------

@@ -492,6 +492,111 @@ def test_restore_partial_mismatched_structure_is_a_clean_error(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Client retry behavior
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_is_capped_exponential_with_retry_after_floor():
+    from marl_distributedformation_tpu.serving import backoff_s
+
+    # The server hint is a FLOOR: sleeping less guarantees a re-reject.
+    assert backoff_s(0, retry_after_s=0.5, base_s=0.05) == 0.5
+    assert backoff_s(5, retry_after_s=3.0, base_s=0.05, cap_s=2.0) == 3.0
+    # The exponential leg grows 2^attempt from base while the hint is
+    # small (the server underestimating its own congestion)...
+    assert backoff_s(0, retry_after_s=0.01, base_s=0.05) == 0.05
+    assert backoff_s(1, retry_after_s=0.01, base_s=0.05) == 0.1
+    assert backoff_s(2, retry_after_s=0.01, base_s=0.05) == 0.2
+    # ...and is capped so a long retry ladder never sleeps for minutes.
+    assert backoff_s(10, retry_after_s=0.01, base_s=0.05, cap_s=2.0) == 2.0
+
+
+def test_client_retries_through_backpressure_and_succeeds():
+    """Opt-in retries absorb transient rejects: a client facing a full
+    queue sleeps the (floored, capped-exponential) backoff and lands the
+    request instead of surfacing BackpressureError to the caller."""
+    engine = _slow_engine(
+        BucketedPolicyEngine(_make_policy(), buckets=(8,)), 0.15
+    )
+    with MicroBatchScheduler(engine, max_queue=1, window_ms=0.0) as sched:
+        client = ServingClient(
+            sched, max_retries=8, backoff_base_s=0.02, backoff_cap_s=0.5
+        )
+        blockers = [sched.submit(_obs(1, seed=0))]  # worker + queue busy
+        try:
+            blockers.append(sched.submit(_obs(1, seed=1)))
+        except BackpressureError:
+            pass
+        actions, _ = client.predict(_obs(2, seed=2))
+        assert actions.shape == (2, 2)
+        assert sched.metrics.rejected_total >= 1, (
+            "the retry path was never exercised"
+        )
+        for f in blockers:
+            assert f.result(timeout=30).actions.shape == (1, 2)
+
+
+def test_client_retries_backpressure_delivered_through_the_future():
+    """A fleet router can deliver BackpressureError through the FUTURE
+    (failover landed on replicas that were all full) — it must consume
+    retry budget exactly like a submit-time reject, not bypass the
+    retry loop."""
+    from concurrent.futures import Future
+
+    from marl_distributedformation_tpu.serving import ServedResult
+
+    class StubTarget:
+        default_timeout_s = 1.0
+
+        def __init__(self):
+            self.calls = 0
+
+        def submit(self, obs, deterministic=True, timeout_s=None):
+            self.calls += 1
+            future = Future()
+            if self.calls == 1:
+                future.set_exception(BackpressureError(0.01))
+            else:
+                future.set_result(
+                    ServedResult(
+                        actions=np.zeros((1, 2), np.float32),
+                        model_step=5,
+                        latency_s=0.0,
+                    )
+                )
+            return future
+
+    stub = StubTarget()
+    client = ServingClient(stub, max_retries=2, backoff_base_s=0.001)
+    result = client.predict_full(np.zeros((1, OBS_DIM), np.float32))
+    assert result.model_step == 5
+    assert stub.calls == 2, "the future-delivered reject must be retried"
+    # And with the budget exhausted, the reject surfaces.
+    stub2 = StubTarget()
+    with pytest.raises(BackpressureError):
+        ServingClient(stub2, max_retries=0).predict_full(
+            np.zeros((1, OBS_DIM), np.float32)
+        )
+
+
+def test_client_with_no_retries_surfaces_the_reject():
+    engine = _slow_engine(
+        BucketedPolicyEngine(_make_policy(), buckets=(8,)), 0.3
+    )
+    with MicroBatchScheduler(engine, max_queue=1, window_ms=0.0) as sched:
+        client = ServingClient(sched, max_retries=0)
+        futures = [sched.submit(_obs(1, seed=0))]
+        try:
+            futures.append(sched.submit(_obs(1, seed=1)))
+        except BackpressureError:
+            pass
+        with pytest.raises(BackpressureError):
+            client.predict(_obs(1, seed=2))
+        for f in futures:
+            assert f.result(timeout=30).actions.shape == (1, 2)
+
+
+# ---------------------------------------------------------------------------
 # Smoke benchmark + CLI
 # ---------------------------------------------------------------------------
 
