@@ -22,6 +22,11 @@ Measures, inside one process and one JSON line:
 - ``scenario_env_steps_per_sec``: env stepping through the 3-layer
   "storm" disturbance stack (scenarios/) — the scenario engine's wrapper
   overhead vs the clean headline (``scenario_overhead_pct``).
+- ``train_env_steps_per_sec_fused_scan``: the Anakin fused-scan trainer
+  (``TrainConfig.fused_chunk``): K full PPO iterations per ``lax.scan``
+  dispatch, best rate over the chunk ladder {1, 8, 32}, with the
+  compile-once RetraceGuard receipts and ``dispatch_overhead_pct`` (the
+  host loop's per-iteration dispatch/drain cost vs the fused program).
 - ``serving_requests_per_sec_fleet`` / ``serving_fleet_p95_ms``: the
   serving-side number — a 2-replica fleet (serving/fleet/) driven by the
   mixed-size smoke storm on a forced 2-device CPU, measured in a
@@ -41,6 +46,7 @@ device op hung for minutes and the round recorded nothing):
 
 Env-var knobs: BENCH_M, BENCH_N, BENCH_CHUNK, BENCH_TRAIN_M, BENCH_KNN_M,
 BENCH_KNN_BIG_M, BENCH_KNN_BIG_N, BENCH_BUDGET_S, BENCH_PROBE_TIMEOUT_S,
+BENCH_FUSED_CHUNKS (default "1,8,32"; empty disables the fused phase),
 BENCH_FORCE_CPU=1, BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1,
 BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1, BENCH_SKIP_SERVING=1,
 BENCH_SERVING_DURATION_S.
@@ -257,6 +263,61 @@ def _time_train_phase(
     return rate, iters / elapsed, ppo.n_steps
 
 
+def _time_fused_phase(n_agents: int, m: int, deadline: float, ppo, chunk: int):
+    """Time the Anakin fused-scan program (``TrainConfig.fused_chunk``):
+    ``chunk`` full PPO iterations per ``lax.scan`` dispatch, per-iteration
+    metrics stacked on-device. Returns
+    ``(train_env_steps_per_sec, iters_per_sec, compile_count)`` —
+    ``compile_count`` is the RetraceGuard receipt (the fused program must
+    compile exactly once per config)."""
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+
+    trainer = Trainer(
+        EnvParams(num_agents=n_agents),
+        ppo=ppo,
+        config=TrainConfig(
+            num_formations=m, checkpoint=False, use_wandb=False,
+            name="bench_fused", fused_chunk=chunk,
+        ),
+    )
+    # Warm up twice, same rationale as _time_train_phase (donated outputs
+    # adopting the program's shardings can retrace the second call). A
+    # large chunk's warmup is a whole compile + 2*K iterations, so check
+    # the deadline between dispatches — a blown budget degrades to a
+    # short timing window instead of starving the watchdog.
+    for _ in range(2):
+        stacked = trainer.run_chunk()
+        float(stacked["loss"][-1])
+        if time.time() > deadline:
+            break
+
+    # Keep >= 2 dispatches in flight between host syncs so the queue
+    # pipelines like the real Anakin loop (drain overlapped with the
+    # next chunk) — a sync after every dispatch would serialize the
+    # mode whose point is not serializing.
+    burst = max(8 // chunk, 2)
+    dispatches = 0
+    t0 = time.perf_counter()
+    while True:
+        for _ in range(burst):
+            stacked = trainer.run_chunk()
+            dispatches += 1
+            if time.time() > deadline:
+                break
+        float(stacked["loss"][-1])  # host sync for the whole burst
+        elapsed = time.perf_counter() - t0
+        if (
+            elapsed >= MIN_TIMED_S
+            or time.time() > deadline
+            or dispatches * chunk >= 256
+        ):
+            break
+    iters = dispatches * chunk
+    rate = trainer.ppo.n_steps * m * iters / elapsed
+    return rate, iters / elapsed, trainer.retrace_guard.count
+
+
 def _latest_chip_bench_claim() -> str:
     """Compose the fallback JSON's pointer at the newest committed chip
     bench record (``docs/acceptance/tpu_bench_r*.md``) at runtime.
@@ -296,10 +357,16 @@ def _latest_chip_bench_claim() -> str:
                 if ln.strip().startswith("{")
             ]
             def _tuned(r: dict) -> float:
+                # Best training rate a record carries, across field
+                # generations (fused_scan since r6, tuned_fused r3-r5,
+                # tuned always).
                 return float(
                     r.get(
-                        "train_env_steps_per_sec_tuned_fused",
-                        r.get("train_env_steps_per_sec_tuned", 0.0),
+                        "train_env_steps_per_sec_fused_scan",
+                        r.get(
+                            "train_env_steps_per_sec_tuned_fused",
+                            r.get("train_env_steps_per_sec_tuned", 0.0),
+                        ),
                     )
                     or 0.0
                 )
@@ -327,8 +394,11 @@ def _latest_chip_bench_claim() -> str:
                 date = m.group(1) if m else "date unrecorded"
             env_rate = float(rec.get("value", 0.0))
             tuned = rec.get(
-                "train_env_steps_per_sec_tuned_fused",
-                rec.get("train_env_steps_per_sec_tuned"),
+                "train_env_steps_per_sec_fused_scan",
+                rec.get(
+                    "train_env_steps_per_sec_tuned_fused",
+                    rec.get("train_env_steps_per_sec_tuned"),
+                ),
             )
             tuned_txt = (
                 f", tuned full-PPO train {float(tuned) / 1e3:,.0f}k "
@@ -679,18 +749,35 @@ def main() -> None:
             else:
                 notes.append("knn-big phase skipped: deadline")
 
-        # Phase 5 — tuned + scan-fused multi-iteration dispatch: the
-        # per-dispatch RTT amortization the trainer exposes as
-        # iters_per_dispatch (VERDICT r3 #6). Runs LAST: its scan compile
-        # is the most expensive and must never starve the long-standing
-        # knn fields of deadline budget.
+        # Phase 5 — Anakin fused-scan training (TrainConfig.fused_chunk,
+        # docs/training.md): the WHOLE rollout+update loop inside one
+        # lax.scan program, K iterations per dispatch, per-iteration
+        # metrics stacked on-device and drained once per chunk. Replaces
+        # the retired iters_per_dispatch burst phase — at the tuned
+        # config the burst never paid for itself (BENCH_r05:
+        # iters_per_dispatch=2 measured 11,147 vs 11,476 plain on CPU;
+        # see docs/training.md "Why the burst path lost"). Records the
+        # best rate over the chunk ladder, the per-chunk rates, the
+        # compile-once RetraceGuard receipts, and the dispatch overhead
+        # the host loop pays relative to the fused program. Runs LAST
+        # among train phases: its scan compiles are the most expensive
+        # and must never starve the long-standing knn fields.
         if os.environ.get("BENCH_SKIP_TRAIN") != "1":
-            fused_r = _env_int(
-                "BENCH_ITERS_PER_DISPATCH", 8 if on_accel else 2
-            )
-            if fused_r <= 1:
-                pass  # explicitly disabled
-            elif time.time() < deadline - 30:
+            try:
+                chunks = [
+                    int(c)
+                    for c in os.environ.get(
+                        "BENCH_FUSED_CHUNKS", "1,8,32"
+                    ).split(",")
+                    if c.strip() and int(c) > 0
+                ]
+            except ValueError as e:
+                # A malformed knob degrades like any phase failure — the
+                # JSON line (and every already-measured field) still
+                # prints.
+                notes.append(f"bad BENCH_FUSED_CHUNKS: {e!r}"[:200])
+                chunks = []
+            if chunks and time.time() < deadline - 30:
                 try:
                     from marl_distributedformation_tpu.algo import PPOConfig
                     from marl_distributedformation_tpu.utils.config import (
@@ -700,28 +787,62 @@ def main() -> None:
                     train_m = _env_int(
                         "BENCH_TRAIN_M", M if on_accel else 256
                     )
-                    fused_rate, fused_iters, _ = _time_train_phase(
-                        N, train_m, deadline,
-                        ppo=PPOConfig(
-                            batch_size=PRESETS["tpu"]["batch_size"]
-                        ),
-                        iters_per_dispatch=fused_r,
+                    tuned_ppo = PPOConfig(
+                        batch_size=PRESETS["tpu"]["batch_size"]
                     )
-                    result["train_env_steps_per_sec_tuned_fused"] = round(
-                        fused_rate, 1
-                    )
-                    result["train_tuned_iters_per_dispatch"] = fused_r
-                    print(
-                        f"[bench] train (tuned, "
-                        f"iters_per_dispatch={fused_r}): "
-                        f"{fused_rate:,.0f} formation-steps/s "
-                        f"({fused_iters:.2f} iters/s)",
-                        file=sys.stderr,
-                    )
+                    rates, receipts = {}, {}
+                    for k_chunk in chunks:
+                        if time.time() > deadline - 15:
+                            notes.append(
+                                f"fused-scan chunk {k_chunk} skipped: "
+                                "deadline"
+                            )
+                            break
+                        f_rate, f_iters, compiles = _time_fused_phase(
+                            N, train_m, deadline, tuned_ppo, k_chunk
+                        )
+                        rates[k_chunk] = f_rate
+                        receipts[str(k_chunk)] = compiles
+                        print(
+                            f"[bench] train (fused-scan, chunk={k_chunk}):"
+                            f" {f_rate:,.0f} formation-steps/s "
+                            f"({f_iters:.2f} iters/s, {compiles} "
+                            "compile)",
+                            file=sys.stderr,
+                        )
+                    if rates:
+                        best = max(rates, key=rates.get)
+                        result["train_env_steps_per_sec_fused_scan"] = (
+                            round(rates[best], 1)
+                        )
+                        result["train_fused_scan_chunk"] = best
+                        result["train_fused_scan_rates"] = {
+                            str(kk): round(v, 1) for kk, v in rates.items()
+                        }
+                        # Compile-once receipt: every fused program must
+                        # have compiled exactly once (tier-1 pins this;
+                        # the bench records the evidence).
+                        result["train_fused_scan_compiles"] = receipts
+                        tuned_prev = result.get(
+                            "train_env_steps_per_sec_tuned"
+                        )
+                        if tuned_prev:
+                            # Share of the fused rate the host loop gives
+                            # back to dispatch/drain overhead at the same
+                            # totals (>= 0: the fused program IS the same
+                            # math minus per-iteration host round trips).
+                            result["dispatch_overhead_pct"] = round(
+                                max(
+                                    0.0,
+                                    (1.0 - tuned_prev / rates[best])
+                                    * 100.0,
+                                ),
+                                1,
+                            )
                 except Exception as e:  # noqa: BLE001 — degrade, don't die
-                    notes.append(f"fused train phase failed: {e!r}"[:200])
-            else:
-                notes.append("fused train phase skipped: deadline")
+                    notes.append(f"fused-scan phase failed: {e!r}"[:200])
+            elif chunks:
+                notes.append("fused-scan phase skipped: deadline")
         # Phase 6 — serving fleet throughput: a 2-replica fleet
         # (serving/fleet/) under the mixed-size smoke storm. Runs in a
         # SUBPROCESS with a forced 2-device CPU backend — the

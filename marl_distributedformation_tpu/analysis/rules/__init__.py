@@ -5,6 +5,9 @@ rule serves every lint run."""
 from typing import List
 
 from marl_distributedformation_tpu.analysis.linter import Rule
+from marl_distributedformation_tpu.analysis.rules.callbacks import (
+    CallbackInHotLoop,
+)
 from marl_distributedformation_tpu.analysis.rules.capture import (
     MutableCaptureInJit,
 )
@@ -39,6 +42,7 @@ RULES = (
     ScanCarryWeakType(),
     VmapInAxesArity(),
     ImplicitF64Promotion(),
+    CallbackInHotLoop(),
 )
 
 

@@ -122,6 +122,23 @@ class ScenarioSchedule:
             probs[names.index(name)] = 1.0
         return probs / probs.sum()
 
+    def severity_chunk(self, rollout: int, k: int) -> np.ndarray:
+        """``(k,)`` float32 severities for rollouts ``[rollout, rollout+k)``
+        — the per-iteration schedule points a fused-scan chunk trains at
+        (stage transitions and ramp steps land INSIDE the chunk, exactly
+        where ``k`` host-loop dispatches would put them)."""
+        return np.asarray(
+            [self.severity_at(rollout + i) for i in range(k)], np.float32
+        )
+
+    def probs_chunk(self, rollout: int, k: int) -> np.ndarray:
+        """``(k, len(names))`` scenario-mix distributions for rollouts
+        ``[rollout, rollout+k)`` on the union ``names`` axis — the scanned
+        twin of :meth:`probs_at`."""
+        return np.stack(
+            [self.probs_at(rollout + i) for i in range(k)], axis=0
+        )
+
 
 def schedule_from_cfg(
     cfg: Any, default_severity: float = 0.5

@@ -3,6 +3,7 @@
 from marl_distributedformation_tpu.train.trainer import (  # noqa: F401
     TrainConfig,
     Trainer,
+    make_fused_chunk,
     make_ppo_iteration,
 )
 from marl_distributedformation_tpu.train.sweep import (  # noqa: F401

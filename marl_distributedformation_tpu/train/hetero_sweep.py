@@ -123,10 +123,11 @@ class HeteroSweepTrainer:
                 "populations are SweepTrainer's domain (drop the "
                 "curriculum), or run one process."
             )
-        if int(config.iters_per_dispatch) > 1:
+        if int(config.iters_per_dispatch) > 1 or int(config.fused_chunk) > 0:
             raise SystemExit(
-                "iters_per_dispatch > 1 does not compose with curriculum "
-                "training (stage boundaries are host-driven); unset it"
+                "iters_per_dispatch > 1 / fused_chunk do not compose with "
+                "curriculum training (stage boundaries are host-driven); "
+                "unset them"
             )
         self.curriculum = curriculum
         if env_params is None:

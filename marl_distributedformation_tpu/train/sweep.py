@@ -65,9 +65,9 @@ from marl_distributedformation_tpu.jax_compat import shard_map
 from marl_distributedformation_tpu.models import MLPActorCritic
 from marl_distributedformation_tpu.train.trainer import (
     TrainConfig,
-    _burst,
     default_total_timesteps,
     fill_ent_schedule,
+    make_fused_chunk,
     make_ppo_iteration,
 )
 from marl_distributedformation_tpu.utils import (
@@ -110,6 +110,14 @@ class SweepTrainer:
         learning_rates: Any = None,
     ) -> None:
         assert num_seeds >= 1
+        if int(config.fused_chunk) > 0:
+            raise SystemExit(
+                "fused_chunk (Anakin fused-scan mode) is a single-run "
+                "Trainer mode — its double-buffered metrics drain and "
+                "background checkpoint pipeline are not wired through the "
+                "population shell; use iters_per_dispatch for sweep "
+                "dispatch fusion"
+            )
         self._multihost = jax.process_count() > 1
         if self._multihost:
             # Multi-host sweeps: every process initializes ONLY its own
@@ -298,7 +306,9 @@ class SweepTrainer:
             # Scan-fuse R population iterations per dispatch, same as the
             # single-run trainer (the burst reductions are axis-0 over the
             # scan, so the (K,) member axis passes through untouched).
-            iteration_pop = _burst(iteration_pop, self._iters_per_dispatch)
+            iteration_pop = make_fused_chunk(
+                iteration_pop, self._iters_per_dispatch, reduce_metrics=True
+            )
         self._iteration = jax.jit(iteration_pop, donate_argnums=(0, 1))
         self._vec_steps_since_save = 0
         self.num_envs = m * env_params.num_agents

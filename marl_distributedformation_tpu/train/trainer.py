@@ -34,8 +34,11 @@ from marl_distributedformation_tpu.env.formation import compute_obs, reset_batch
 from marl_distributedformation_tpu.models import MLPActorCritic
 from marl_distributedformation_tpu.utils import profiling
 from marl_distributedformation_tpu.utils import (
+    AsyncCheckpointWriter,
     MetricsLogger,
     Throughput,
+    checkpoint_path,
+    device_snapshot,
     latest_checkpoint,
     repo_root,
     restore_checkpoint_partial,
@@ -67,6 +70,14 @@ class TrainConfig:
     #   jitted program via lax.scan — one host dispatch (one tunnel RTT)
     #   advances R iterations. Metrics/logging/checkpoint cadence quantize
     #   to R; metrics are the mean over the burst (dones: sum).
+    fused_chunk: int = 0  # Anakin mode (docs/training.md): >0 compiles K
+    #   rollout+update iterations into ONE lax.scan program with the full
+    #   training state as the donated carry. Per-iteration metrics come
+    #   back STACKED (one batched device_get per chunk, double-buffered
+    #   against the next chunk's execution) and checkpoints are written by
+    #   a background thread off a device-side snapshot. Chunk boundary =
+    #   checkpoint boundary; logging stays per-iteration. Mutually
+    #   exclusive with iters_per_dispatch (the host-loop burst spelling).
     profile: bool = False  # capture a jax.profiler trace of a few
     #   post-warmup iterations into {log_dir}/profile/ (profile=true CLI)
     profile_iterations: int = 3
@@ -211,35 +222,53 @@ def make_ppo_iteration(
     return iteration
 
 
-def _burst(iteration, r: int):
-    """Fuse ``r`` training iterations into one function via ``lax.scan``
-    (TrainConfig.iters_per_dispatch): a tunneled device pays ONE dispatch
-    RTT per ``r`` rollout+update cycles — the trainer-side version of
-    bench.py's burst-sync trick (VERDICT r3 #6). Metrics reduce on-device
-    (mean over the burst; ``episode_dones`` sums) so the host transfer
-    stays one small pytree."""
+def make_fused_chunk(iteration, k: int, reduce_metrics: bool = False):
+    """Fuse ``k`` rollout+update iterations into ONE ``lax.scan`` device
+    program — the Podracer "Anakin" dispatch shape (PAPERS.md): the carry
+    is the full training state ``(train_state, env_state, obs, key)``
+    (donated by the caller's jit), the host touches the device once per
+    chunk, and per-iteration metrics come back stacked along a leading
+    ``(k,)`` axis so a whole chunk's telemetry drains in one batched
+    ``device_get``.
 
-    def burst(train_state, env_state, obs, key, *extra):
-        # ``extra`` (scenario params) is constant across the fused burst —
-        # severity/mix resampling quantizes to the dispatch cadence, the
-        # same quantization logging and checkpoints already accept.
-        def body(carry, _):
+    Scenario params, when present, ride as the scan's xs with a leading
+    ``(k,)`` axis — every fused iteration trains at its own schedule
+    point, exactly like ``k`` host-loop dispatches (bitwise; pinned by
+    tests/test_fused_scan.py).
+
+    ``reduce_metrics=True`` keeps the legacy burst contract
+    (``TrainConfig.iters_per_dispatch``: mean over the chunk,
+    ``episode_dones`` sums) for callers whose shell consumes one reduced
+    metrics pytree per dispatch (SweepTrainer); the fused-scan trainer
+    keeps the full stack. This replaces the former ``_burst`` helper —
+    one scan builder serves both cadences, so the two can never drift.
+    """
+
+    def fused_chunk_iteration(train_state, env_state, obs, key, *scenario_seq):
+        def body(carry, xs):
             train_state, env_state, obs, key = carry
+            extra = () if xs is None else (xs,)
             train_state, env_state, obs, key, metrics = iteration(
                 train_state, env_state, obs, key, *extra
             )
             return (train_state, env_state, obs, key), metrics
 
+        xs = scenario_seq[0] if scenario_seq else None
         (train_state, env_state, obs, key), stacked = jax.lax.scan(
-            body, (train_state, env_state, obs, key), None, length=r
+            body, (train_state, env_state, obs, key), xs, length=k
         )
-        metrics = {
-            k: (v.sum(axis=0) if k == "episode_dones" else v.mean(axis=0))
-            for k, v in stacked.items()
-        }
-        return train_state, env_state, obs, key, metrics
+        if reduce_metrics:
+            stacked = {
+                name: (
+                    v.sum(axis=0)
+                    if name == "episode_dones"
+                    else v.mean(axis=0)
+                )
+                for name, v in stacked.items()
+            }
+        return train_state, env_state, obs, key, stacked
 
-    return burst
+    return fused_chunk_iteration
 
 
 class Trainer:
@@ -394,6 +423,19 @@ class Trainer:
                     num_formations=config.num_formations,
                 )
             )
+            # Chunked twin: ONE jitted pass draws the per-iteration param
+            # batches for a whole fused chunk (leading (k,) axis over
+            # keys/severities/probs — all data, so this compiles once per
+            # chunk size and never retraces across stages or ramps).
+            self._sample_scenario_chunk = jax.jit(
+                jax.vmap(
+                    functools.partial(
+                        sample_scenario_batch,
+                        specs=self._scenario_specs,
+                        num_formations=config.num_formations,
+                    )
+                )
+            )
             # Base key for the sampling stream; per-dispatch keys fold in
             # the global rollout index, so the stream is a pure function
             # of (seed, rollout) and resume continues it exactly instead
@@ -408,11 +450,41 @@ class Trainer:
         self._vec_steps_since_save = 0
         self._iteration_core = self._make_iteration()
         self._iters_per_dispatch = max(1, int(config.iters_per_dispatch))
-        dispatch_fn = (
-            _burst(self._iteration_core, self._iters_per_dispatch)
-            if self._iters_per_dispatch > 1
-            else self._iteration_core
-        )
+        self._fused_chunk = max(0, int(config.fused_chunk))
+        if self._fused_chunk and self._iters_per_dispatch > 1:
+            raise SystemExit(
+                "fused_chunk and iters_per_dispatch are two spellings of "
+                "dispatch fusion — set exactly one (fused_chunk is the "
+                "Anakin mode: stacked per-iteration metrics, double-"
+                "buffered drain, background checkpoints; "
+                "iters_per_dispatch is the host-loop burst)"
+            )
+        if self._fused_chunk and config.profile:
+            raise SystemExit(
+                "profile=true does not compose with fused_chunk: the "
+                "profiler loop is iteration-grained and a fused chunk is "
+                "one opaque device program — profile the host-loop mode "
+                "(drop fused_chunk) or capture a trace manually around "
+                "run_chunk()"
+            )
+        if self._fused_chunk and self._multihost:
+            raise SystemExit(
+                "fused-scan training is single-host for now (the async "
+                "checkpoint writer has no cross-host durability barrier); "
+                "drop fused_chunk or run single-process"
+            )
+        if self._fused_chunk:
+            dispatch_fn = make_fused_chunk(
+                self._iteration_core, self._fused_chunk
+            )
+        elif self._iters_per_dispatch > 1:
+            dispatch_fn = make_fused_chunk(
+                self._iteration_core,
+                self._iters_per_dispatch,
+                reduce_metrics=True,
+            )
+        else:
+            dispatch_fn = self._iteration_core
         # Retrace guard (analysis/guards.py): counts every compilation of
         # the outermost jitted dispatch; with guard_retraces=N the trace
         # that exceeds N raises RetraceError naming the drifting argument
@@ -462,6 +534,26 @@ class Trainer:
             jnp.asarray(schedule.probs_at(self._scenario_rollouts)),
         )
 
+    def _next_scenario_chunk(self, k: int):
+        """Stacked ``ScenarioParams`` (leading ``(k,)`` axis) for the next
+        ``k`` rollouts ``[r0, r0+k)`` — the scan's xs for a fused chunk.
+        Keys fold in each GLOBAL rollout index and severities/probs come
+        off the schedule per iteration, so every scanned iteration trains
+        at exactly the params the host loop would have drawn at its
+        rollout index (bitwise; tests/test_fused_scan.py) and resume
+        re-enters mid-schedule unchanged. One jitted pass, values-only:
+        stage changes and severity ramps never retrace."""
+        schedule = self._scenario_schedule
+        r0 = self._scenario_rollouts
+        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            self._scenario_base_key, jnp.arange(r0, r0 + k)
+        )
+        return self._sample_scenario_chunk(
+            keys,
+            jnp.asarray(schedule.severity_chunk(r0, k)),
+            jnp.asarray(schedule.probs_chunk(r0, k)),
+        )
+
     # ------------------------------------------------------------------
     # Imperative shell
     # ------------------------------------------------------------------
@@ -470,10 +562,10 @@ class Trainer:
     def total_timesteps(self) -> int:
         return default_total_timesteps(self.config)
 
-    def run_iteration(self) -> Dict[str, float]:
-        """One dispatch — ``iters_per_dispatch`` rollout+update cycles
-        (1 by default); returns device metrics (burst-averaged when
-        fused)."""
+    def _dispatch(self, rollouts: int) -> Dict[str, Array]:
+        """Dispatch the jitted program once (``rollouts`` iterations of
+        training), under the opt-in runtime guards, and advance the host
+        counters. Shared by the host-loop and fused-scan shells."""
         with contextlib.ExitStack() as stack:
             if self.config.guard_transfers and self._dispatches > 0:
                 # Post-warmup only: the compile dispatch legitimately
@@ -482,10 +574,17 @@ class Trainer:
                 stack.enter_context(profiling.no_host_transfers())
             if self.config.guard_nans:
                 stack.enter_context(profiling.nan_guard())
-            extra = (
-                () if self.scenario_params is None
-                else (self.scenario_params,)
-            )
+            if self.scenario_params is None:
+                extra = ()
+            elif self._fused_chunk or rollouts > 1:
+                # Chunked dispatch (any fused_chunk — a K=1 scan still
+                # takes xs with a leading (1,) axis — or a legacy burst):
+                # each scanned iteration gets the params the host loop
+                # would draw at its rollout index, resampled per
+                # iteration — not one batch frozen across the chunk.
+                extra = (self._next_scenario_chunk(rollouts),)
+            else:
+                extra = (self.scenario_params,)
             (
                 self.train_state,
                 self.env_state,
@@ -496,17 +595,44 @@ class Trainer:
                 self.train_state, self.env_state, self.obs, self.key, *extra
             )
         self._dispatches += 1
-        r = self._iters_per_dispatch
-        self.num_timesteps += r * self.ppo.n_steps * self.num_envs
-        self._vec_steps_since_save += r * self.ppo.n_steps
+        self.num_timesteps += rollouts * self.ppo.n_steps * self.num_envs
+        self._vec_steps_since_save += rollouts * self.ppo.n_steps
         if self._scenario_schedule is not None:
-            self._scenario_rollouts += r
-            self._resample_scenario_params()
+            self._scenario_rollouts += rollouts
+            if not self._fused_chunk and rollouts == 1:
+                # Chunked modes draw their params from
+                # _next_scenario_chunk at dispatch time — resampling the
+                # single-dispatch batch here would be one wasted device
+                # program per chunk on the hot path.
+                self._resample_scenario_params()
         return metrics
+
+    def run_iteration(self) -> Dict[str, float]:
+        """One host-loop dispatch — ``iters_per_dispatch`` rollout+update
+        cycles (1 by default); returns device metrics (burst-averaged
+        when fused)."""
+        assert not self._fused_chunk, (
+            "fused_chunk trainers dispatch via run_chunk() (stacked "
+            "per-iteration metrics), not run_iteration()"
+        )
+        return self._dispatch(self._iters_per_dispatch)
+
+    def run_chunk(self) -> Dict[str, Array]:
+        """Anakin mode: dispatch ONE fused-scan chunk (``fused_chunk``
+        iterations) and return the per-iteration metrics stack as DEVICE
+        arrays (leading ``(k,)`` axis). The call returns as soon as the
+        program is enqueued — the caller overlaps the host drain of the
+        previous chunk with this one's execution (see ``_train_fused``)."""
+        assert self._fused_chunk > 0, (
+            "run_chunk() needs fused_chunk > 0 (Anakin mode)"
+        )
+        return self._dispatch(self._fused_chunk)
 
     def train(self) -> Dict[str, float]:
         """Full training run with metrics + checkpoints; returns the last
         emitted metrics record."""
+        if self._fused_chunk:
+            return self._train_fused()
         logger = MetricsLogger(
             self.log_dir,
             run_name=self.config.name,
@@ -581,6 +707,107 @@ class Trainer:
                 jax.profiler.stop_trace()
             logger.close()
         return last_record
+
+    # ------------------------------------------------------------------
+    # Anakin mode (fused_chunk > 0): whole-loop scan dispatch with an
+    # async metrics drain and a background checkpoint pipeline
+    # (docs/training.md "Anakin mode").
+    # ------------------------------------------------------------------
+
+    def _train_fused(self) -> Dict[str, float]:
+        """Fused-scan driver: dispatch chunk N+1 BEFORE draining chunk
+        N's metrics (double-buffered — the device computes while the host
+        logs), and checkpoint at chunk boundaries on a background writer
+        thread off a device-side snapshot. The emitted records are
+        per-iteration, identical to the host loop's (log_interval honored
+        on the global iteration index)."""
+        logger = MetricsLogger(
+            self.log_dir,
+            run_name=self.config.name,
+            use_wandb=self.config.use_wandb,
+            use_tensorboard=self.config.use_tensorboard,
+        )
+        meter = Throughput()
+        writer = AsyncCheckpointWriter() if self.config.checkpoint else None
+        last_record: Dict[str, float] = {}
+        k = self._fused_chunk
+        iteration = 0
+        pending = None  # the chunk in flight, drained one dispatch later
+        try:
+            while self.num_timesteps < self.total_timesteps:
+                steps_before = self.num_timesteps
+                severities = (
+                    self._scenario_schedule.severity_chunk(
+                        self._scenario_rollouts, k
+                    )
+                    if self._scenario_schedule is not None
+                    else None
+                )
+                stacked = self.run_chunk()
+                if pending is not None:
+                    last_record = (
+                        self._drain_chunk(logger, meter, *pending)
+                        or last_record
+                    )
+                pending = (stacked, iteration, steps_before, severities)
+                iteration += k
+                if (
+                    writer is not None
+                    and self._vec_steps_since_save >= self.config.save_freq
+                ):
+                    self.save_async(writer)
+            if pending is not None:
+                last_record = (
+                    self._drain_chunk(logger, meter, *pending) or last_record
+                )
+            if writer is not None:
+                self.save_async(writer)
+                writer.close()  # the final write is durable before return
+                writer = None
+        finally:
+            if writer is not None:
+                # Unwinding on an error: drain the writer without letting
+                # a secondary write failure mask the original exception.
+                writer.close_quietly()
+            logger.close()
+        return last_record
+
+    def _drain_chunk(
+        self, logger, meter, stacked, first_iteration, steps_before,
+        severities,
+    ) -> Dict[str, float]:
+        """ONE batched ``device_get`` for a whole chunk's telemetry, then
+        emit per-iteration records exactly like the host loop would.
+        Called after the NEXT chunk has been dispatched, so this blocks on
+        the finished chunk while the device already runs the new one."""
+        host = jax.device_get(stacked)
+        meter.tick(
+            self._fused_chunk * self.ppo.n_steps * self.config.num_formations
+        )
+        per_iter = self.ppo.n_steps * self.num_envs
+        last_record: Dict[str, float] = {}
+        for i in range(self._fused_chunk):
+            if (first_iteration + i + 1) % self.config.log_interval:
+                continue
+            record = {name: float(v[i]) for name, v in host.items()}
+            record["env_steps_per_sec"] = meter.rate()
+            if severities is not None:
+                record["scenario_severity"] = float(severities[i])
+            logger.log(record, steps_before + (i + 1) * per_iter)
+            last_record = record
+        return last_record
+
+    def save_async(self, writer: AsyncCheckpointWriter) -> str:
+        """Chunk-boundary checkpoint that never stalls the dispatch
+        pipeline: snapshot the state on DEVICE (async copies enqueued
+        behind the chunk that produced it — the next chunk's donation
+        cannot invalidate them; utils.device_snapshot), then hand the
+        snapshot to the writer thread, which ``device_get``s and writes
+        atomically while the device keeps training."""
+        path = checkpoint_path(self.log_dir, self.num_timesteps)
+        writer.submit(path, device_snapshot(self._checkpoint_target()))
+        self._vec_steps_since_save = 0
+        return str(path)
 
     def profile_breakdown(self, iters: int = 10) -> Dict[str, float]:
         """Where does the train-iteration time go? Times the full jitted

@@ -11,9 +11,11 @@ from marl_distributedformation_tpu.utils.config import (  # noqa: F401
     validate_override_keys,
 )
 from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
+    AsyncCheckpointWriter,
     broadcast_restore,
     checkpoint_path,
     checkpoint_step,
+    device_snapshot,
     latest_checkpoint,
     latest_sweep_state,
     restore_checkpoint,

@@ -15,6 +15,7 @@ file — host-side, TPU-independent, and restorable on any backend.
 from __future__ import annotations
 
 import re
+import threading
 from pathlib import Path
 from typing import Any, Optional
 
@@ -85,6 +86,88 @@ def _write_atomic(path: Path, target: Any) -> None:
     target = jax.device_get(target)
     tmp.write_bytes(serialization.to_bytes(target))
     tmp.replace(path)  # atomic: no torn checkpoints (SURVEY.md §5)
+
+
+def device_snapshot(target: Any) -> Any:
+    """Device-side copy of every array leaf of a checkpoint target.
+
+    The fused-scan trainer donates its state buffers to the next chunk's
+    dispatch; handing the LIVE tree to a background writer would race the
+    donation (the writer's ``device_get`` would read deleted buffers).
+    ``jnp.copy`` enqueues one async device copy per leaf *behind* the
+    program that produces the state — the copies are data-dependent on it
+    and independent of everything after, so the next chunk can donate and
+    overwrite the originals while the writer drains the snapshot. Host
+    leaves (step counters, name strings) pass through untouched.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, target
+    )
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint pipeline: ``device_get`` + atomic write on a
+    writer thread, so a training loop's ``save`` costs one async device
+    copy (:func:`device_snapshot`) instead of a synchronous serialize.
+
+    At most ONE write is in flight — ``submit`` joins the previous write
+    first, which bounds snapshot memory to one checkpoint and keeps the
+    on-disk step order monotonic. A failed write surfaces as
+    ``RuntimeError`` on the next ``submit``/``close`` (never silently);
+    the torn-write invariant is :func:`_write_atomic`'s — a crash at any
+    point leaves only a dot-prefixed ``.tmp`` file that
+    :func:`latest_checkpoint` can never pick up.
+    """
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def submit(self, path: str | Path, target: Any) -> Path:
+        """Queue one atomic write of ``target`` to ``path``. ``target``
+        must already be safe to read from another thread (host arrays, or
+        a :func:`device_snapshot` the caller's donation cannot touch)."""
+        self.wait()
+        path = Path(path)
+        thread = threading.Thread(
+            target=self._run, args=(path, target),
+            daemon=True, name="ckpt-writer",
+        )
+        self._thread = thread
+        thread.start()
+        return path
+
+    def _run(self, path: Path, target: Any) -> None:
+        try:
+            _write_atomic(path, target)
+        except BaseException as e:  # noqa: BLE001 — surfaced on wait()
+            self._error = e
+
+    def wait(self) -> None:
+        """Join the in-flight write (if any); re-raise its failure."""
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join()
+        err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                f"async checkpoint write failed: {err!r}"
+            ) from err
+
+    def close(self) -> None:
+        """Drain the pipeline; raises if the last write failed."""
+        self.wait()
+
+    def close_quietly(self) -> None:
+        """Teardown on an already-failing path: join without raising (a
+        write error must not mask the exception that is unwinding)."""
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join()
+        self._error = None
 
 
 def save_sweep_state(

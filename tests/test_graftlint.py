@@ -464,6 +464,66 @@ FIXTURES = [
             return mapped, star, scalar
         """,
     ),
+    (
+        "callback-in-hot-loop",
+        """
+        import jax, jax.numpy as jnp
+        from jax import lax
+
+        def train(xs):
+            def body(carry, x):
+                jax.debug.print("reward {r}", r=x)  # host RTT per step
+                return carry + x, x
+            return lax.scan(body, jnp.zeros(()), xs)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def debug_step(x):
+            # one transfer per dispatch, not inside a compiled loop: fine
+            jax.debug.print("x = {x}", x=x)
+            return x * 2
+
+        def train(xs):
+            def body(carry, x):
+                return carry + x, x  # telemetry stacked in the scan output
+            carry, stacked = lax.scan(body, jnp.zeros(()), xs)
+            jax.debug.print("chunk done: {c}", c=carry)  # once per chunk
+            return carry, stacked
+        """,
+    ),
+    (
+        "callback-in-hot-loop",
+        """
+        import jax
+        from jax import lax
+
+        def emit(metrics):
+            jax.experimental.io_callback(print, None, metrics)
+
+        def train(steps, state):
+            def body(i, state):
+                emit(state)  # reaches io_callback: host RTT per step
+                return state
+            return lax.fori_loop(0, steps, body, state)
+        """,
+        """
+        import jax
+        from jax import lax
+
+        def emit(metrics):
+            jax.experimental.io_callback(print, None, metrics)
+
+        def train(steps, state):
+            def body(i, state):
+                return state
+            state = lax.fori_loop(0, steps, body, state)
+            emit(state)  # outside the loop: once per chunk, fine
+            return state
+        """,
+    ),
 ]
 
 
@@ -509,6 +569,25 @@ def test_package_scan_covers_serving():
     assert len(served) >= 6, f"serving/ missing from the lint scan: {files}"
     fleet = [f for f in served if "fleet" in f.parts]
     assert len(fleet) >= 6, f"serving/fleet/ missing from the scan: {served}"
+
+
+def test_package_scan_covers_train_modules():
+    """The zero-violation pin must include every train/ module (the
+    fused-scan trainer is the hottest scan in the repo — exactly where
+    callback-in-hot-loop and the donation/scan rules earn their keep)
+    plus the scenario schedule the fused chunk samples from."""
+    from marl_distributedformation_tpu.analysis import load_config
+    from marl_distributedformation_tpu.analysis.linter import iter_python_files
+
+    files = list(iter_python_files([PACKAGE], load_config(REPO), root=REPO))
+    train = {f.name for f in files if "train" in f.parts}
+    assert {
+        "trainer.py", "sweep.py", "curriculum.py", "hetero_sweep.py",
+    } <= train, f"train/ modules missing from the lint scan: {train}"
+    scenarios = {f.name for f in files if "scenarios" in f.parts}
+    assert "schedule.py" in scenarios, (
+        f"scenarios/schedule.py missing from the scan: {scenarios}"
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -61,6 +61,10 @@ def train_config_from_config(cfg) -> TrainConfig:
         log_interval=cfg.log_interval,
         profile=bool(cfg.get("profile", False)),
         iters_per_dispatch=int(cfg.get("iters_per_dispatch", 1)),
+        # Anakin mode (docs/training.md): K iterations per lax.scan
+        # dispatch, stacked metrics drained double-buffered, checkpoints
+        # on a background writer. fused_chunk=32 is a good TPU default.
+        fused_chunk=int(cfg.get("fused_chunk", 0)),
         # Runtime tracing guards (analysis/guards.py): guard_retraces=1
         # enforces the compiles-exactly-once contract on the train step.
         guard_retraces=int(cfg.get("guard_retraces", 0)),
