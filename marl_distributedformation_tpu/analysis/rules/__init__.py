@@ -26,6 +26,9 @@ from marl_distributedformation_tpu.analysis.rules.prng import PrngKeyReuse
 from marl_distributedformation_tpu.analysis.rules.scan_carry import (
     ScanCarryWeakType,
 )
+from marl_distributedformation_tpu.analysis.rules.sharding_drift import (
+    ScanCarryShardingDrift,
+)
 from marl_distributedformation_tpu.analysis.rules.vmap_axes import (
     VmapInAxesArity,
 )
@@ -43,6 +46,7 @@ RULES = (
     VmapInAxesArity(),
     ImplicitF64Promotion(),
     CallbackInHotLoop(),
+    ScanCarryShardingDrift(),
 )
 
 

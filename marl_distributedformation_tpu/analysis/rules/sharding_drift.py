@@ -1,0 +1,190 @@
+"""scan-carry-sharding-drift: a ``lax.scan`` carry leaf whose sharding
+constraint in the body differs from the init's.
+
+The fused-scan trainers donate the whole training state into a scan
+whose carry must alias the input buffers (``donate_argnums``). A carry
+leaf pinned to one sharding at the scan boundary
+(``with_sharding_constraint(x, P('dp'))`` on the init) but to a
+*different* spec inside the body forces XLA to materialize a resharded
+copy every iteration — the donation silently stops aliasing (memory
+doubles) or, across dispatches, the drifted output sharding retraces
+the jitted program. The fix is one line: make the body's constraint
+agree with the producing value's (or drop one of the two and let
+propagation decide consistently).
+
+Detection is positional and deliberately conservative: for each
+``lax.scan(body, init, ...)`` whose body resolves in the same module,
+the rule pairs the init expression's leaves with the body's returned
+carry leaves (tuple/list displays element-by-element; a lone leaf as
+itself) and compares the sharding specs it can SEE — a leaf that is a
+direct ``with_sharding_constraint(...)`` call, or a name assigned from
+one in the enclosing scope. Both sides known and textually different →
+violation. Unannotated sides stay silent (the producer's sharding is
+whatever propagation gives both sides consistently).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+_SCAN_NAMES = frozenset({"jax.lax.scan", "lax.scan"})
+_WSC_NAMES = frozenset(
+    {
+        "jax.lax.with_sharding_constraint",
+        "lax.with_sharding_constraint",
+        "with_sharding_constraint",
+    }
+)
+
+Path = Tuple[int, ...]
+
+
+def _wsc_spec(node: ast.AST) -> Optional[str]:
+    """The normalized spec text of a direct with_sharding_constraint
+    call, else None."""
+    if (
+        isinstance(node, ast.Call)
+        and dotted_name(node.func) in _WSC_NAMES
+        and len(node.args) >= 2
+    ):
+        return ast.unparse(node.args[1])
+    return None
+
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _scoped_walk(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``scope`` WITHOUT descending into nested functions — a scan
+    body that rebinds the init's variable name must not be mistaken for
+    the init's own binding (its assignment is a different scope), and a
+    module-level fallback must not pick up sibling functions' names."""
+    stack = [scope]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, _FN_NODES):
+                stack.append(child)
+
+
+def _assigned_specs(scope: ast.AST) -> Dict[str, Optional[str]]:
+    """Name -> spec for simple assignments ``x = with_sharding_constraint
+    (..., spec)`` directly in ``scope`` (nested function bodies are other
+    scopes and are skipped). The LAST assignment (source order) wins —
+    the idiomatic spelling computes first, constrains last (``h = f(x);
+    h = with_sharding_constraint(h, P(...))``); a name whose final
+    binding is unconstrained maps to None."""
+    last: Dict[str, Tuple[int, Optional[str]]] = {}
+    for node in _scoped_walk(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        spec = _wsc_spec(node.value)
+        for target in node.targets:
+            if not isinstance(target, ast.Name):
+                continue
+            seen = last.get(target.id)
+            if seen is None or node.lineno >= seen[0]:
+                last[target.id] = (node.lineno, spec)
+    return {name: spec for name, (_, spec) in last.items()}
+
+
+def _leaf_specs(
+    expr: ast.AST,
+    names: Dict[str, Optional[str]],
+    path: Path = (),
+) -> Iterator[Tuple[Path, str, ast.AST]]:
+    """(position-path, spec, node) for every leaf of a tuple/list display
+    whose sharding constraint is syntactically visible."""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        for i, elt in enumerate(expr.elts):
+            yield from _leaf_specs(elt, names, (*path, i))
+        return
+    spec = _wsc_spec(expr)
+    if spec is None and isinstance(expr, ast.Name):
+        spec = names.get(expr.id)
+    if spec is not None:
+        yield path, spec, expr
+
+
+class ScanCarryShardingDrift(Rule):
+    name = "scan-carry-sharding-drift"
+    default_severity = "error"
+    description = (
+        "lax.scan carry leaf whose with_sharding_constraint in the body "
+        "differs from the init's — under donation XLA reshards a copy "
+        "every iteration instead of aliasing the buffer (or retraces on "
+        "the drifted output sharding); make the two specs agree"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in _SCAN_NAMES or not node.args:
+                continue
+            init = node.args[1] if len(node.args) >= 2 else next(
+                (kw.value for kw in node.keywords if kw.arg == "init"),
+                None,
+            )
+            if init is None:
+                continue
+            # The init's bindings live in the function that CALLS scan
+            # (traced or not), never in the scan body or a sibling —
+            # nearest function ancestor, module as the fallback.
+            scope = next(
+                (
+                    a
+                    for a in ctx._ancestors(node)
+                    if isinstance(a, _FN_NODES)
+                ),
+                ctx.tree,
+            )
+            init_names = _assigned_specs(scope)
+            init_specs = {
+                p: (spec, leaf)
+                for p, spec, leaf in _leaf_specs(init, init_names)
+            }
+            if not init_specs:
+                continue
+            for body in ctx._resolve_callable(node.args[0]):
+                body_names = _assigned_specs(body)
+                # scan bodies return (carry, ys); collect every returned
+                # carry expression (a lambda's is its body expression).
+                returned = []
+                if isinstance(body, ast.Lambda):
+                    returned.append(body.body)
+                else:
+                    returned.extend(
+                        ret.value
+                        for ret in ast.walk(body)
+                        if isinstance(ret, ast.Return)
+                        and ret.value is not None
+                    )
+                carries = [
+                    value.elts[0]
+                    for value in returned
+                    if isinstance(value, ast.Tuple) and len(value.elts) == 2
+                ]
+                for carry in carries:
+                    for p, spec, leaf in _leaf_specs(carry, body_names):
+                        known = init_specs.get(p)
+                        if known is None or known[0] == spec:
+                            continue
+                        yield (
+                            leaf.lineno,
+                            leaf.col_offset,
+                            f"scan carry leaf at position {list(p) or '()'}"
+                            f" is constrained to {spec} in the body but "
+                            f"its init is constrained to {known[0]} — a "
+                            "donated carry with drifting sharding "
+                            "annotations reshards a copy per iteration "
+                            "(or retraces); make the specs agree",
+                        )

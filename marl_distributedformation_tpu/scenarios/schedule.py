@@ -21,6 +21,7 @@ Config forms accepted by ``schedule_from_cfg`` (cfg key ``scenarios``):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -122,22 +123,80 @@ class ScenarioSchedule:
             probs[names.index(name)] = 1.0
         return probs / probs.sum()
 
+    @functools.cached_property
+    def _stage_table(self):
+        """Vectorized twin of the per-rollout walk — one numpy row per
+        stage: ``(starts, rollouts, lo, hi, probs_matrix)``. Chunked
+        sampling at population scale calls the chunk methods once per
+        fused dispatch with ``k`` up to the chunk size; an O(k · stages)
+        Python loop there is measurable host work on the dispatch lane,
+        while this table turns both chunk methods into a handful of
+        vectorized ops. (``cached_property`` stores via the instance
+        ``__dict__``, bypassing the frozen-dataclass ``__setattr__``.)"""
+        starts, rollouts, lo, hi = [], [], [], []
+        probs = []
+        done = 0
+        prev_end = 0.0
+        names = self.names
+        for stage in self.stages:
+            starts.append(done)
+            rollouts.append(stage.rollouts)
+            lo.append(
+                stage.severity_start
+                if stage.severity_start is not None
+                else prev_end
+            )
+            hi.append(stage.severity)
+            row = np.zeros((len(names),), np.float32)
+            for name in stage.scenarios:
+                row[names.index(name)] = 1.0
+            probs.append(row / row.sum())
+            prev_end = stage.severity
+            done += stage.rollouts
+        return (
+            np.asarray(starts, np.int64),
+            np.asarray(rollouts, np.int64),
+            np.asarray(lo, np.float64),
+            np.asarray(hi, np.float64),
+            np.stack(probs, axis=0),
+        )
+
+    def _stage_indices(self, rollout: int, k: int) -> np.ndarray:
+        starts, rollouts, _, _, _ = self._stage_table
+        r = np.arange(rollout, rollout + k)
+        # Past-the-end rollouts hold the last stage (stage_at's clamp).
+        return np.minimum(
+            np.searchsorted(starts + rollouts, r, side="right"),
+            len(starts) - 1,
+        )
+
     def severity_chunk(self, rollout: int, k: int) -> np.ndarray:
         """``(k,)`` float32 severities for rollouts ``[rollout, rollout+k)``
         — the per-iteration schedule points a fused-scan chunk trains at
         (stage transitions and ramp steps land INSIDE the chunk, exactly
-        where ``k`` host-loop dispatches would put them)."""
-        return np.asarray(
-            [self.severity_at(rollout + i) for i in range(k)], np.float32
+        where ``k`` host-loop dispatches would put them). Vectorized over
+        the chunk, element-for-element identical to :meth:`severity_at`
+        (same float64 ramp arithmetic, rounded to f32 at the end)."""
+        starts, rollouts, lo, hi, _ = self._stage_table
+        idx = self._stage_indices(rollout, k)
+        r = np.arange(rollout, rollout + k)
+        # Rollouts past the schedule clamp to the final severity
+        # (frac=1); single-rollout stages ramp straight to `hi`.
+        within = np.minimum(r - starts[idx], rollouts[idx] - 1)
+        frac = np.where(
+            rollouts[idx] > 1,
+            within / np.maximum(rollouts[idx] - 1, 1),
+            1.0,
         )
+        return (lo[idx] + (hi[idx] - lo[idx]) * frac).astype(np.float32)
 
     def probs_chunk(self, rollout: int, k: int) -> np.ndarray:
         """``(k, len(names))`` scenario-mix distributions for rollouts
         ``[rollout, rollout+k)`` on the union ``names`` axis — the scanned
-        twin of :meth:`probs_at`."""
-        return np.stack(
-            [self.probs_at(rollout + i) for i in range(k)], axis=0
-        )
+        twin of :meth:`probs_at`, one table gather instead of a per-index
+        stage walk."""
+        _, _, _, _, probs = self._stage_table
+        return probs[self._stage_indices(rollout, k)]
 
 
 def schedule_from_cfg(

@@ -35,8 +35,14 @@ Composition of two existing shells, not new machinery:
 Deliberate scope (documented restrictions, enforced loudly):
 single-controller only (the config-5 acceptance runs on one chip; use
 ``SweepTrainer`` for multi-host populations), no per-member learning
-rates, and no ``iters_per_dispatch`` (stage boundaries are host-driven,
-same as ``HeteroTrainer``). ``resume=true`` restores the latest
+rates, and no ``iters_per_dispatch`` (retired for sweeps).
+``fused_chunk=K`` (round 6) DOES compose: within a stage, K vmapped
+iterations fuse into one ``lax.scan`` dispatch — chunks clip at the
+host-driven stage boundaries (a stage tail shorter than K compiles its
+own scan length, once, cached), telemetry drains double-buffered, and
+population checkpoints write async off a device-side snapshot at chunk
+boundaries (``tests/test_fused_sweep.py`` pins bitwise parity with the
+host loop across stage changes). ``resume=true`` restores the latest
 ``sweep_state_*`` population checkpoint — params, batched optimizer
 state, member PRNG streams, env state, per-member counters, and the
 curriculum cursor — and continues bit-identically to an uninterrupted
@@ -50,6 +56,7 @@ path (__graft_entry__.py).
 
 from __future__ import annotations
 
+import functools
 from pathlib import Path
 from typing import Any, Dict, Optional
 
@@ -79,14 +86,22 @@ from marl_distributedformation_tpu.train.sweep import (
 from marl_distributedformation_tpu.train.trainer import (
     TrainConfig,
     fill_ent_schedule,
+    make_fused_chunk,
 )
 from marl_distributedformation_tpu.utils import (
+    AsyncCheckpointWriter,
     MetricsLogger,
     Throughput,
+    device_snapshot,
     latest_sweep_state,
+    own_restored,
     repo_root,
-    save_checkpoint,
     save_sweep_state,
+)
+from marl_distributedformation_tpu.utils import profiling
+from marl_distributedformation_tpu.utils.checkpoint import (
+    _write_atomic,
+    checkpoint_path,
 )
 
 Array = jax.Array
@@ -123,12 +138,14 @@ class HeteroSweepTrainer:
                 "populations are SweepTrainer's domain (drop the "
                 "curriculum), or run one process."
             )
-        if int(config.iters_per_dispatch) > 1 or int(config.fused_chunk) > 0:
+        if int(config.iters_per_dispatch) > 1:
             raise SystemExit(
-                "iters_per_dispatch > 1 / fused_chunk do not compose with "
-                "curriculum training (stage boundaries are host-driven); "
-                "unset them"
+                "iters_per_dispatch is retired for population sweeps — "
+                "set fused_chunk=K instead (chunks clip at curriculum "
+                "stage boundaries, so staged training now composes with "
+                "scan fusion)"
             )
+        self._fused_chunk = max(0, int(config.fused_chunk))
         self.curriculum = curriculum
         if env_params is None:
             env_params = EnvParams()
@@ -210,7 +227,21 @@ class HeteroSweepTrainer:
                 out_specs=spec,
                 check_vma=False,
             )
-        self._iteration = jax.jit(iteration_pop, donate_argnums=(0, 1))
+        self._iteration_pop = iteration_pop
+        # ONE guard across the host-loop program and every fused chunk
+        # length: `count` is the total number of compiles this trainer
+        # triggered. A curriculum whose stage lengths divide fused_chunk
+        # compiles exactly once; a clipped stage tail costs one extra
+        # compile per DISTINCT tail length (cached below, never per
+        # dispatch) — size the guard_retraces budget accordingly.
+        self.retrace_guard = profiling.RetraceGuard(
+            "hetero_sweep_iteration",
+            max_traces=config.guard_retraces or None,
+        )
+        self._iteration = jax.jit(
+            self.retrace_guard.wrap(iteration_pop), donate_argnums=(0, 1)
+        )
+        self._fused_programs: Dict[int, Any] = {}
 
         self.env_state = None
         self.obs = None
@@ -335,12 +366,58 @@ class HeteroSweepTrainer:
         self._vec_steps_since_save += self.ppo.n_steps
         return metrics
 
+    def _fused_dispatch(self, r: int):
+        """The jitted fused program for an ``r``-iteration chunk, cached
+        per length. Stage boundaries are host-driven env rebuilds, so a
+        chunk never crosses one — stage tails shorter than ``fused_chunk``
+        dispatch through a shorter scan, compiled once per distinct
+        length and shared by every stage with that remainder."""
+        fn = self._fused_programs.get(r)
+        if fn is None:
+            fn = jax.jit(
+                self.retrace_guard.wrap(
+                    make_fused_chunk(self._iteration_pop, r)
+                ),
+                donate_argnums=(0, 1),
+            )
+            self._fused_programs[r] = fn
+        return fn
+
+    def run_chunk(self, r: Optional[int] = None) -> Dict[str, Array]:
+        """Anakin mode: dispatch ONE fused-scan chunk of ``r`` vmapped
+        iterations (default ``fused_chunk``; callers clip ``r`` at stage
+        boundaries) and return the stacked ``(r, num_seeds, ...)`` device
+        metrics. Returns as soon as the program is enqueued."""
+        assert self._fused_chunk > 0, (
+            "run_chunk() needs fused_chunk > 0 (Anakin mode)"
+        )
+        assert self.env_state is not None, "call start_stage() first"
+        r = self._fused_chunk if r is None else int(r)
+        (
+            self.train_state,
+            self.env_state,
+            self.obs,
+            self.key,
+            stacked,
+        ) = self._fused_dispatch(r)(
+            self.train_state, self.env_state, self.obs, self.key
+        )
+        # Active-agent mixes are frozen within a stage and chunks never
+        # cross one, so the per-member accounting of r host iterations
+        # collapses to one increment.
+        self.num_timesteps_members += r * self.ppo.n_steps * self._active_agents
+        self.completed_rollouts += r
+        self._vec_steps_since_save += r * self.ppo.n_steps
+        return stacked
+
     def train(self) -> Dict[str, float]:
         """Run the full curriculum for every member; logs population
         aggregates per rollout (sweep metric contract: ``reward`` is the
         population mean plus ``reward_best``/``reward_worst``/
         ``best_seed``) and writes per-member checkpoints + the ranking
         summary at the end."""
+        if self._fused_chunk:
+            return self._train_fused()
         logger = MetricsLogger(
             self.log_dir,
             run_name=self.config.name,
@@ -348,6 +425,9 @@ class HeteroSweepTrainer:
             use_tensorboard=self.config.use_tensorboard,
         )
         meter = Throughput()
+        tracer = profiling.TraceWindow(
+            self.log_dir, self.config.profile, self.config.profile_iterations
+        )
         record: Dict[str, float] = {}
         # Resume continuity: the log_interval cadence is phased on the
         # GLOBAL rollout index, so a resumed run logs the same rollouts
@@ -365,6 +445,17 @@ class HeteroSweepTrainer:
                 if self.completed_rollouts >= stage_end:
                     continue  # resumed past this stage — don't replay it
                 if (
+                    self.config.total_timesteps is not None
+                    and self.num_timesteps >= self.config.total_timesteps
+                ):
+                    # Budget bound BEFORE the stage reset: starting the
+                    # stage just to stop would burn a key split and an env
+                    # resample, so the final checkpoint would hold
+                    # post-reset state — and a resume (completed_rollouts
+                    # == stage_start) would re-run start_stage from that
+                    # key and silently diverge from an uninterrupted run.
+                    break
+                if (
                     self.completed_rollouts == stage_start
                     or self.env_state is None
                 ):
@@ -380,7 +471,9 @@ class HeteroSweepTrainer:
                     ):
                         done_budget = True
                         break
+                    tracer.before_dispatch()
                     metrics = self.run_iteration()
+                    tracer.after_dispatch(metrics)
                     iteration += 1
                     meter.tick(
                         self.ppo.n_steps
@@ -406,27 +499,173 @@ class HeteroSweepTrainer:
                 self.save()
                 self._write_summary(np.asarray(final["reward"]))
         finally:
+            tracer.close()
             logger.close()
         return record
+
+    # ------------------------------------------------------------------
+    # Anakin mode (fused_chunk > 0): fused-scan chunks clipped at stage
+    # boundaries, double-buffered drain, async population checkpoints.
+    # ------------------------------------------------------------------
+
+    def _train_fused(self) -> Dict[str, float]:
+        """Fused-scan curriculum driver. The stage walk is the host
+        loop's — stage resets stay host-driven — but within a stage the
+        iterations dispatch as fused chunks of ``min(fused_chunk,
+        rollouts left in the stage)``: chunk N+1 (or the next stage's
+        first chunk) is dispatched BEFORE chunk N's stacked telemetry
+        drains, and population checkpoints write on the background
+        writer off a device-side snapshot at chunk boundaries. An
+        explicit ``total_timesteps`` cap quantizes to the chunk (checked
+        between dispatches — the member == single-run equivalence
+        already only holds for non-binding caps, see
+        ``total_timesteps``)."""
+        logger = MetricsLogger(
+            self.log_dir,
+            run_name=self.config.name,
+            use_wandb=self.config.use_wandb,
+            use_tensorboard=self.config.use_tensorboard,
+        )
+        meter = Throughput()
+        writer = AsyncCheckpointWriter() if self.config.checkpoint else None
+        tracer = profiling.TraceWindow(
+            self.log_dir, self.config.profile, self.config.profile_iterations
+        )
+        record: Dict[str, float] = {}
+        final_rewards = None
+        pending = None  # the chunk in flight, drained one dispatch later
+        done_budget = False
+        try:
+            stage_end = 0
+            for stage_idx, stage in enumerate(self.curriculum.stages):
+                if done_budget:
+                    break
+                stage_start = stage_end
+                stage_end = stage_start + stage.rollouts
+                if self.completed_rollouts >= stage_end:
+                    continue  # resumed past this stage — don't replay it
+                if (
+                    self.config.total_timesteps is not None
+                    and self.num_timesteps >= self.config.total_timesteps
+                ):
+                    # Budget bound before the stage reset (the host-loop
+                    # rule): never burn a key split on a stage that will
+                    # not train — the boundary checkpoint must hold the
+                    # PRE-reset key so resume replays start_stage exactly
+                    # once, identically to an uninterrupted run.
+                    break
+                if (
+                    self.completed_rollouts == stage_start
+                    or self.env_state is None
+                ):
+                    self.start_stage(stage)
+                # else: resumed MID-stage — continue without resampling
+                # (the host-loop rule); the next chunks re-clip to the
+                # stage remainder, so resume re-enters bit-exactly.
+                while self.completed_rollouts < stage_end:
+                    if (
+                        self.config.total_timesteps is not None
+                        and self.num_timesteps
+                        >= self.config.total_timesteps
+                    ):
+                        done_budget = True
+                        break
+                    r = min(
+                        self._fused_chunk,
+                        stage_end - self.completed_rollouts,
+                    )
+                    first_iteration = self.completed_rollouts
+                    steps_before = self.num_timesteps_members.copy()
+                    active = self._active_agents.copy()
+                    tracer.before_dispatch()
+                    stacked = self.run_chunk(r)
+                    tracer.after_dispatch(stacked)
+                    if pending is not None:
+                        rec, final_rewards = self._drain_chunk(
+                            logger, meter, *pending
+                        )
+                        record = rec or record
+                    pending = (
+                        stacked, r, first_iteration, steps_before,
+                        active, stage_idx,
+                    )
+                    if (
+                        writer is not None
+                        and self._vec_steps_since_save
+                        >= self.config.save_freq
+                    ):
+                        self.save_async(writer)
+            if pending is not None:
+                rec, final_rewards = self._drain_chunk(
+                    logger, meter, *pending
+                )
+                record = rec or record
+            if self.config.checkpoint:
+                if writer is not None:
+                    self.save_async(writer)
+                    writer.close()  # final write durable before the summary
+                    writer = None
+                if final_rewards is not None:
+                    self._write_summary(final_rewards)
+        finally:
+            tracer.close()
+            if writer is not None:
+                writer.close_quietly()
+            logger.close()
+        return record
+
+    def _drain_chunk(self, logger, meter, stacked, r, first_iteration,
+                     steps_before, active, stage_idx):
+        """ONE batched ``device_get`` for a chunk's population telemetry;
+        emit per-iteration aggregate records at the host loop's step
+        stamps (reconstructed from the per-member counters BEFORE the
+        chunk plus the stage's frozen active-agent counts). Returns
+        ``(last_emitted_record, final_iteration_rewards)``."""
+        host = jax.device_get(stacked)
+        meter.tick(
+            r * self.ppo.n_steps * self.config.num_formations
+            * self.num_seeds
+        )
+        record: Dict[str, float] = {}
+        for i in range(r):
+            if (first_iteration + i + 1) % self.config.log_interval:
+                continue
+            rec = self._aggregate(
+                {name: v[i] for name, v in host.items()}
+            )
+            rec["env_steps_per_sec"] = meter.rate()
+            rec["curriculum_stage"] = float(stage_idx)
+            step = int(
+                (steps_before + (i + 1) * self.ppo.n_steps * active).max()
+            )
+            logger.log(rec, step)
+            record = rec
+        return record, np.asarray(host["reward"][-1])
 
     def _aggregate(self, host: Dict[str, np.ndarray]) -> Dict[str, float]:
         return population_aggregate(host, self.config.seed)
 
-    def save(self) -> None:
-        """Per-member checkpoints under ``{log_dir}/seed{i}/`` — each
-        plays back / fine-tunes through the standard single-run tooling
-        (``visualize_policy.py name={name}/seed{i}``). One batched device
-        pull serves every member (tunneled-TPU rule: sync once, slice on
-        host)."""
-        host = jax.device_get(
-            {
-                "params": self.train_state.params,
-                "opt_state": self.train_state.opt_state,
-                "key": self.key,
-                "env_state": self.env_state,
-                "obs": self.obs,
-            }
-        )
+    def _device_target(self) -> Dict[str, Any]:
+        return {
+            "params": self.train_state.params,
+            "opt_state": self.train_state.opt_state,
+            "key": self.key,
+            "env_state": self.env_state,
+            "obs": self.obs,
+        }
+
+    def _write_population_files(
+        self, tree: Dict[str, Any], members: np.ndarray, rollouts: int
+    ) -> None:
+        """Write one LOGICAL population checkpoint: per-member
+        ``seed{i}/rl_model_*`` files (standard single-run tooling plays
+        them back / fine-tunes them) plus the ``sweep_state`` resume
+        anchor. ``tree`` is a host pull or a ``device_snapshot`` (the
+        async writer thread drains either in one batched ``device_get``);
+        ``members``/``rollouts`` are the progress counters captured when
+        the checkpoint was requested. The anchor writes LAST so discovery
+        never finds an anchor whose member files are missing."""
+        host = jax.device_get(tree)
         for i in range(self.num_seeds):
             # np.array: owning copies, not views keeping the full
             # population tree alive (the SweepTrainer.member_state rule).
@@ -438,37 +677,61 @@ class HeteroSweepTrainer:
                 "params": take(host["params"]),
                 "opt_state": take(host["opt_state"]),
                 "key": np.array(host["key"][i]),
-                "num_timesteps": int(self.num_timesteps_members[i]),
-                "completed_rollouts": self.completed_rollouts,
+                "num_timesteps": int(members[i]),
+                "completed_rollouts": int(rollouts),
             }
-            save_checkpoint(
-                Path(self.log_dir) / f"seed{i}",
-                int(self.num_timesteps_members[i]),
+            _write_atomic(
+                checkpoint_path(
+                    Path(self.log_dir) / f"seed{i}", int(members[i])
+                ),
                 state,
-                sync=False,
             )
         # ONE population-state file so an interrupted block RESUMES
         # (resume=true) mid-curriculum instead of retraining from
         # scratch — the identity fields are validated on restore.
         save_sweep_state(
             self.log_dir,
-            self.num_timesteps,
+            int(members.max(initial=0)),
             {
                 "policy": self.model.__class__.__name__,
                 "num_seeds": self.num_seeds,
                 "seed": int(self.config.seed),
                 "num_formations": int(self.config.num_formations),
                 "curriculum_spec": self._curriculum_spec(),
-                "num_timesteps_members": np.asarray(
-                    self.num_timesteps_members
-                ),
-                "completed_rollouts": self.completed_rollouts,
+                "num_timesteps_members": np.asarray(members),
+                "completed_rollouts": int(rollouts),
                 **{
                     k: host[k]
                     for k in ("params", "opt_state", "key",
                               "env_state", "obs")
                 },
             },
+        )
+
+    def save(self) -> None:
+        """Synchronous population checkpoint: one batched device pull
+        serves every member (tunneled-TPU rule: sync once, slice on
+        host), then per-member files + the sweep_state anchor."""
+        self._write_population_files(
+            jax.device_get(self._device_target()),
+            self.num_timesteps_members.copy(),
+            self.completed_rollouts,
+        )
+        self._vec_steps_since_save = 0
+
+    def save_async(self, writer: AsyncCheckpointWriter) -> None:
+        """Chunk-boundary population checkpoint off a device-side
+        snapshot (``utils.device_snapshot``): the writer thread drains
+        and writes while the device runs the next chunk; the progress
+        counters are captured NOW, so the files record the state the
+        snapshot actually holds."""
+        writer.submit_write(
+            functools.partial(
+                self._write_population_files,
+                device_snapshot(self._device_target()),
+                self.num_timesteps_members.copy(),
+                self.completed_rollouts,
+            )
         )
         self._vec_steps_since_save = 0
 
@@ -551,6 +814,11 @@ class HeteroSweepTrainer:
             name: serialization.from_state_dict(tmpl, raw[name])
             for name, tmpl in template.items()
         }
+        # Owning copies BEFORE the donating dispatch sees this state
+        # (utils.own_restored: msgpack leaves can alias the checkpoint
+        # bytes; donation of an aliased buffer is a use-after-free on
+        # the zero-copy CPU backend).
+        restored = own_restored(restored)
         self.train_state = self.train_state.replace(
             params=restored["params"], opt_state=restored["opt_state"]
         )

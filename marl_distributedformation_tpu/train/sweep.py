@@ -42,10 +42,24 @@ and progress — and continues bit-identically to an uninterrupted run
 (pinned by ``tests/test_sweep.py``). Operationally critical on hardware
 that can vanish mid-run for hours (the tunneled-TPU reality this repo
 benches on).
+
+Anakin population mode (round 6): ``fused_chunk=K`` compiles K whole
+vmapped population iterations into ONE ``lax.scan`` program (the
+single-run trainer's fused-scan shape, docs/training.md), so the host
+dispatch overhead that used to be paid per population iteration is paid
+once per chunk. Per-member metrics come back stacked
+``(fused_chunk, num_seeds, ...)`` and drain in one batched ``device_get``
+per chunk, double-buffered against the next chunk's execution;
+population checkpoints (every member file + the sweep_state anchor)
+write on a background thread off a device-side snapshot, at chunk
+boundaries — chunk boundary == checkpoint boundary == bit-exact resume
+boundary (pinned by ``tests/test_fused_sweep.py``). The old
+``iters_per_dispatch`` reduced-metrics burst is retired for sweeps.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 from pathlib import Path
 from typing import Any, Dict, Optional
@@ -71,13 +85,22 @@ from marl_distributedformation_tpu.train.trainer import (
     make_ppo_iteration,
 )
 from marl_distributedformation_tpu.utils import (
+    AsyncCheckpointWriter,
     MetricsLogger,
     Throughput,
+    device_snapshot,
     latest_checkpoint,
     latest_sweep_state,
+    own_restored,
     repo_root,
     save_checkpoint,
     save_sweep_state,
+)
+from marl_distributedformation_tpu.utils import profiling
+from marl_distributedformation_tpu.utils.checkpoint import (
+    _write_atomic,
+    checkpoint_path,
+    sweep_state_path,
 )
 
 Array = jax.Array
@@ -110,15 +133,27 @@ class SweepTrainer:
         learning_rates: Any = None,
     ) -> None:
         assert num_seeds >= 1
-        if int(config.fused_chunk) > 0:
+        self._fused_chunk = max(0, int(config.fused_chunk))
+        if int(config.iters_per_dispatch) > 1:
+            # The reduced-metrics burst cadence is RETIRED for sweeps:
+            # fused_chunk subsumes it (same scan fusion, but metrics come
+            # back stacked per iteration and checkpoints go async) and
+            # measured >= it at every chunk size. Reject loudly rather
+            # than silently training at cadence 1.
             raise SystemExit(
-                "fused_chunk (Anakin fused-scan mode) is a single-run "
-                "Trainer mode — its double-buffered metrics drain and "
-                "background checkpoint pipeline are not wired through the "
-                "population shell; use iters_per_dispatch for sweep "
-                "dispatch fusion"
+                "iters_per_dispatch is retired for population sweeps — "
+                "set fused_chunk=K instead (the Anakin mode: K vmapped "
+                "iterations per lax.scan dispatch, per-member metrics "
+                "stacked per iteration, async population checkpoints)"
             )
         self._multihost = jax.process_count() > 1
+        if self._fused_chunk and self._multihost:
+            raise SystemExit(
+                "fused-scan sweeps are single-host for now (the async "
+                "population checkpoint writer allgathers off-thread, "
+                "which has no cross-host durability barrier); drop "
+                "fused_chunk or run single-process"
+            )
         if self._multihost:
             # Multi-host sweeps: every process initializes ONLY its own
             # members (per-host construction, parallel/distributed.py
@@ -301,15 +336,20 @@ class SweepTrainer:
                 # parallel/mesh.py).
                 check_vma=False,
             )
-        self._iters_per_dispatch = max(1, int(config.iters_per_dispatch))
-        if self._iters_per_dispatch > 1:
-            # Scan-fuse R population iterations per dispatch, same as the
-            # single-run trainer (the burst reductions are axis-0 over the
-            # scan, so the (K,) member axis passes through untouched).
-            iteration_pop = make_fused_chunk(
-                iteration_pop, self._iters_per_dispatch, reduce_metrics=True
-            )
-        self._iteration = jax.jit(iteration_pop, donate_argnums=(0, 1))
+        if self._fused_chunk:
+            # Anakin population mode: fused_chunk whole vmapped
+            # iterations in ONE lax.scan — the (members,) axis rides
+            # through the scan untouched, so per-member per-iteration
+            # metrics come back stacked (fused_chunk, members, ...).
+            iteration_pop = make_fused_chunk(iteration_pop, self._fused_chunk)
+        # Compile-once receipt for the population program (bench records
+        # it; guard_retraces=1 enforces it).
+        self.retrace_guard = profiling.RetraceGuard(
+            "sweep_iteration", max_traces=config.guard_retraces or None
+        )
+        self._iteration = jax.jit(
+            self.retrace_guard.wrap(iteration_pop), donate_argnums=(0, 1)
+        )
         self._vec_steps_since_save = 0
         self.num_envs = m * env_params.num_agents
 
@@ -342,9 +382,9 @@ class SweepTrainer:
     def total_timesteps(self) -> int:
         return default_total_timesteps(self.config)
 
-    def run_iteration(self) -> Dict[str, Array]:
-        """One vectorized iteration; metrics values carry a leading (K,)
-        seed axis."""
+    def _dispatch(self, rollouts: int):
+        """Dispatch the jitted population program once (``rollouts``
+        iterations for every member) and advance the host counters."""
         (
             self.train_state,
             self.env_state,
@@ -354,10 +394,29 @@ class SweepTrainer:
         ) = self._iteration(
             self.train_state, self.env_state, self.obs, self.key
         )
-        r = self._iters_per_dispatch
-        self.num_timesteps += r * self.ppo.n_steps * self.num_envs
-        self._vec_steps_since_save += r * self.ppo.n_steps
+        self.num_timesteps += rollouts * self.ppo.n_steps * self.num_envs
+        self._vec_steps_since_save += rollouts * self.ppo.n_steps
         return metrics
+
+    def run_iteration(self) -> Dict[str, Array]:
+        """One vectorized iteration; metrics values carry a leading (K,)
+        seed axis."""
+        assert not self._fused_chunk, (
+            "fused_chunk sweeps dispatch via run_chunk() (stacked "
+            "per-iteration metrics), not run_iteration()"
+        )
+        return self._dispatch(1)
+
+    def run_chunk(self) -> Dict[str, Array]:
+        """Anakin population mode: dispatch ONE fused-scan chunk
+        (``fused_chunk`` vmapped iterations) and return the metrics stack
+        as DEVICE arrays with leading ``(fused_chunk, num_seeds)`` axes.
+        Returns as soon as the program is enqueued — ``_train_fused``
+        overlaps the previous chunk's drain with this one's execution."""
+        assert self._fused_chunk > 0, (
+            "run_chunk() needs fused_chunk > 0 (Anakin mode)"
+        )
+        return self._dispatch(self._fused_chunk)
 
     def _host_population(self) -> Dict[str, Any]:
         """ONE batched device pull of everything checkpoints need — on a
@@ -376,12 +435,17 @@ class SweepTrainer:
         )
 
     def member_state(
-        self, i: int, host: Optional[Dict[str, Any]] = None
+        self,
+        i: int,
+        host: Optional[Dict[str, Any]] = None,
+        steps: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Slice member ``i``'s full learner state out of the population —
         a standard (Trainer-compatible) checkpoint target. Pass ``host``
         (from ``_host_population``) when saving many members so the
-        device pull happens once."""
+        device pull happens once; ``steps`` pins the recorded progress
+        (the async writer captures it at submit time — the live counter
+        has moved on by the time the writer thread runs)."""
         if host is None:
             host = self._host_population()
         # np.array (not asarray): slices of the shared host pull must be
@@ -394,7 +458,9 @@ class SweepTrainer:
             "policy": self.model.__class__.__name__,
             "params": take(host["params"]),
             "key": np.array(host["key"][i]),
-            "num_timesteps": self.num_timesteps,
+            "num_timesteps": (
+                self.num_timesteps if steps is None else int(steps)
+            ),
             # Provenance the single-run resume path checks: fine-tuning a
             # member at a different rate than it trained with warns loudly.
             "learning_rate": float(
@@ -439,7 +505,9 @@ class SweepTrainer:
         )
         self._vec_steps_since_save = 0
 
-    def _population_target(self, host: Dict[str, Any]) -> Dict[str, Any]:
+    def _population_target(
+        self, host: Dict[str, Any], steps: Optional[int] = None
+    ) -> Dict[str, Any]:
         """The full resume anchor: everything ``run_iteration`` threads,
         batched over the (K,) seed axis — including the lr-sweep's
         ``inject_hyperparams`` state, which member checkpoints must omit
@@ -451,12 +519,61 @@ class SweepTrainer:
             "num_seeds": self.num_seeds,
             "seed": int(self.config.seed),
             "num_formations": int(self.config.num_formations),
-            "num_timesteps": self.num_timesteps,
+            "num_timesteps": (
+                self.num_timesteps if steps is None else int(steps)
+            ),
             **host,
         }
         if self._lrs_host is not None:
             target["learning_rates"] = self._lrs_host
         return target
+
+    def _write_population_files(self, tree: Dict[str, Any], steps: int):
+        """Write one LOGICAL population checkpoint — every member's
+        ``rl_model_{steps}_steps`` file plus the ``sweep_state`` resume
+        anchor — from ``tree`` (a host pull, or a ``device_snapshot`` when
+        called on the async writer thread; ``device_get`` drains either in
+        one batched transfer). Single-controller only: the async path
+        fail-fasts multi-host in ``__init__``, so no durability barrier
+        is needed here. The sweep_state anchor is written LAST — if the
+        process dies mid-logical-checkpoint, resume discovery never sees
+        an anchor whose member files are missing."""
+        host = jax.device_get(tree)
+        for i in range(self.num_seeds):
+            _write_atomic(
+                checkpoint_path(Path(self.log_dir) / f"seed{i}", steps),
+                self.member_state(i, host, steps),
+            )
+        _write_atomic(
+            sweep_state_path(self.log_dir, steps),
+            self._population_target(host, steps),
+        )
+
+    def save_async(self, writer: AsyncCheckpointWriter) -> None:
+        """Chunk-boundary population checkpoint that never stalls the
+        dispatch lane: snapshot the full sweep state ON DEVICE
+        (``utils.device_snapshot`` — the copies are enqueued behind the
+        chunk that produced the state, so the next chunk's donation
+        cannot invalidate them), then hand the snapshot to the writer
+        thread, which drains and writes every member file + the
+        sweep_state anchor while the device keeps training. Chunk
+        boundary == checkpoint boundary == bit-exact resume boundary."""
+        assert not self._multihost
+        snapshot = device_snapshot(
+            {
+                "params": self.train_state.params,
+                "opt_state": self.train_state.opt_state,
+                "key": self.key,
+                "env_state": self.env_state,
+                "obs": self.obs,
+            }
+        )
+        writer.submit_write(
+            functools.partial(
+                self._write_population_files, snapshot, self.num_timesteps
+            )
+        )
+        self._vec_steps_since_save = 0
 
     def _try_resume(self) -> None:
         """Restore the latest ``sweep_state_*`` population checkpoint into
@@ -473,6 +590,11 @@ class SweepTrainer:
             self._note_no_population_file()
             return
         restored, steps, stored_lrs = self._read_population_file(path)
+        # Owning copies BEFORE the donating dispatch sees this state:
+        # msgpack_restore leaves can view the checkpoint's byte buffer,
+        # and donating an aliased buffer is a use-after-free on the
+        # zero-copy CPU backend (utils.own_restored).
+        restored = own_restored(restored)
         self._adopt_checkpoint_lrs(stored_lrs)
         self.train_state = self.train_state.replace(
             params=restored["params"], opt_state=restored["opt_state"]
@@ -643,6 +765,8 @@ class SweepTrainer:
         """Full sweep; logs population-aggregate metrics per rollout and
         writes per-member checkpoints + a ranking summary at the end.
         Returns the final aggregate record."""
+        if self._fused_chunk:
+            return self._train_fused()
         logger = MetricsLogger(
             self.log_dir,
             run_name=self.config.name,
@@ -650,16 +774,20 @@ class SweepTrainer:
             use_tensorboard=self.config.use_tensorboard,
         )
         meter = Throughput()
+        tracer = profiling.TraceWindow(
+            self.log_dir, self.config.profile, self.config.profile_iterations
+        )
         record: Dict[str, float] = {}
         iteration = 0
         metrics = None
         try:
             while self.num_timesteps < self.total_timesteps:
+                tracer.before_dispatch()
                 metrics = self.run_iteration()
+                tracer.after_dispatch(metrics)
                 iteration += 1
                 meter.tick(
-                    self._iters_per_dispatch
-                    * self.ppo.n_steps
+                    self.ppo.n_steps
                     * self.config.num_formations
                     * self.num_seeds
                 )
@@ -684,8 +812,110 @@ class SweepTrainer:
                     self.save()
                     self._write_summary(np.asarray(final["reward"]))
         finally:
+            tracer.close()
             logger.close()
         return record
+
+    # ------------------------------------------------------------------
+    # Anakin population mode (fused_chunk > 0): whole-loop scan dispatch
+    # for every member at once, double-buffered telemetry drain, async
+    # population checkpoints (docs/training.md "Population fusion").
+    # ------------------------------------------------------------------
+
+    def _train_fused(self) -> Dict[str, float]:
+        """Fused-scan population driver: dispatch chunk N+1 BEFORE
+        draining chunk N's stacked ``(fused_chunk, num_seeds, ...)``
+        telemetry (the device trains while the host aggregates and logs),
+        and checkpoint the whole population at chunk boundaries on the
+        background writer off a device-side snapshot. Emitted records are
+        per-iteration population aggregates — identical cadence and step
+        stamps to the host loop's."""
+        logger = MetricsLogger(
+            self.log_dir,
+            run_name=self.config.name,
+            use_wandb=self.config.use_wandb,
+            use_tensorboard=self.config.use_tensorboard,
+        )
+        meter = Throughput()
+        writer = AsyncCheckpointWriter() if self.config.checkpoint else None
+        tracer = profiling.TraceWindow(
+            self.log_dir, self.config.profile, self.config.profile_iterations
+        )
+        record: Dict[str, float] = {}
+        final_rewards = None
+        k = self._fused_chunk
+        iteration = 0
+        pending = None  # the chunk in flight, drained one dispatch later
+        try:
+            while self.num_timesteps < self.total_timesteps:
+                steps_before = self.num_timesteps
+                tracer.before_dispatch()
+                stacked = self.run_chunk()
+                tracer.after_dispatch(stacked)
+                if pending is not None:
+                    rec, final_rewards = self._drain_chunk(
+                        logger, meter, *pending
+                    )
+                    record = rec or record
+                pending = (stacked, iteration, steps_before)
+                iteration += k
+                if (
+                    writer is not None
+                    and self._vec_steps_since_save >= self.config.save_freq
+                ):
+                    self.save_async(writer)
+            if pending is not None:
+                rec, final_rewards = self._drain_chunk(
+                    logger, meter, *pending
+                )
+                record = rec or record
+            if self.config.checkpoint:
+                if writer is not None:
+                    self.save_async(writer)
+                    writer.close()  # final write durable before the summary
+                    writer = None
+                if final_rewards is not None:
+                    # Rank on the final iteration's rewards, matching the
+                    # final checkpoints (the host-loop rule).
+                    self._write_summary(final_rewards)
+        finally:
+            tracer.close()
+            if writer is not None:
+                # Unwinding on an error: drain the writer without letting
+                # a secondary write failure mask the original exception.
+                writer.close_quietly()
+            logger.close()
+        return record
+
+    def _drain_chunk(self, logger, meter, stacked, first_iteration,
+                     steps_before):
+        """ONE batched ``device_get`` for a whole chunk's population
+        telemetry, then emit per-iteration aggregate records exactly like
+        the host loop would (``log_interval`` phased on the global
+        iteration index). Called after the NEXT chunk has been
+        dispatched, so this blocks on the finished chunk while the device
+        already runs the new one. Returns ``(last_emitted_record,
+        final_iteration_rewards)`` — the rewards feed the ranking
+        summary."""
+        host = jax.device_get(stacked)
+        meter.tick(
+            self._fused_chunk
+            * self.ppo.n_steps
+            * self.config.num_formations
+            * self.num_seeds
+        )
+        per_iter = self.ppo.n_steps * self.num_envs
+        record: Dict[str, float] = {}
+        for i in range(self._fused_chunk):
+            if (first_iteration + i + 1) % self.config.log_interval:
+                continue
+            rec = self._aggregate(
+                {name: v[i] for name, v in host.items()}
+            )
+            rec["env_steps_per_sec"] = meter.rate()
+            logger.log(rec, steps_before + (i + 1) * per_iter)
+            record = rec
+        return record, np.asarray(host["reward"][-1])
 
     def _aggregate(self, host: Dict[str, np.ndarray]) -> Dict[str, float]:
         return population_aggregate(host, self.config.seed)

@@ -40,6 +40,7 @@ from marl_distributedformation_tpu.utils import (
     checkpoint_path,
     device_snapshot,
     latest_checkpoint,
+    own_restored,
     repo_root,
     restore_checkpoint_partial,
     save_checkpoint,
@@ -79,8 +80,11 @@ class TrainConfig:
     #   checkpoint boundary; logging stays per-iteration. Mutually
     #   exclusive with iters_per_dispatch (the host-loop burst spelling).
     profile: bool = False  # capture a jax.profiler trace of a few
-    #   post-warmup iterations into {log_dir}/profile/ (profile=true CLI)
-    profile_iterations: int = 3
+    #   post-warmup dispatches into {log_dir}/profile/ (profile=true CLI).
+    #   Composes with fused_chunk: the capture window is DISPATCH-grained
+    #   (utils.profiling.TraceWindow), so fused mode traces
+    #   profile_iterations whole chunks instead of fail-fasting.
+    profile_iterations: int = 3  # dispatches to trace (chunks when fused)
     # Runtime tracing guards (analysis/guards.py; docs/static_analysis.md).
     guard_retraces: int = 0  # >0: fail the run if the jitted train
     #   iteration compiles more than this many times (1 = the steady-state
@@ -238,10 +242,11 @@ def make_fused_chunk(iteration, k: int, reduce_metrics: bool = False):
 
     ``reduce_metrics=True`` keeps the legacy burst contract
     (``TrainConfig.iters_per_dispatch``: mean over the chunk,
-    ``episode_dones`` sums) for callers whose shell consumes one reduced
-    metrics pytree per dispatch (SweepTrainer); the fused-scan trainer
-    keeps the full stack. This replaces the former ``_burst`` helper —
-    one scan builder serves both cadences, so the two can never drift.
+    ``episode_dones`` sums) for the single-run ``Trainer``'s host-loop
+    burst spelling — its ONLY remaining consumer now that both population
+    sweeps dispatch through the stacked-metrics fused path. This replaces
+    the former ``_burst`` helper — one scan builder serves both cadences,
+    so the two can never drift.
     """
 
     def fused_chunk_iteration(train_state, env_state, obs, key, *scenario_seq):
@@ -459,14 +464,6 @@ class Trainer:
                 "buffered drain, background checkpoints; "
                 "iters_per_dispatch is the host-loop burst)"
             )
-        if self._fused_chunk and config.profile:
-            raise SystemExit(
-                "profile=true does not compose with fused_chunk: the "
-                "profiler loop is iteration-grained and a fused chunk is "
-                "one opaque device program — profile the host-loop mode "
-                "(drop fused_chunk) or capture a trace manually around "
-                "run_chunk()"
-            )
         if self._fused_chunk and self._multihost:
             raise SystemExit(
                 "fused-scan training is single-host for now (the async "
@@ -642,29 +639,17 @@ class Trainer:
         meter = Throughput()
         last_record: Dict[str, float] = {}
         iteration = 0
-        # profile=true: trace a few post-warmup iterations (iteration 1 is
-        # compile-bound and would dominate the trace). NB: named
-        # trace_active, not "profiling" — that name is the utils.profiling
-        # module import at the top of this file.
-        trace_active = False
-        profile_stop = 1 + max(1, self.config.profile_iterations)
+        # profile=true: trace a few post-warmup dispatches (the first is
+        # compile-bound and would dominate the trace).
+        tracer = profiling.TraceWindow(
+            self.log_dir, self.config.profile, self.config.profile_iterations
+        )
         try:
             while self.num_timesteps < self.total_timesteps:
-                if self.config.profile and iteration == 1 and not trace_active:
-                    import os
-
-                    profile_dir = os.path.join(self.log_dir, "profile")
-                    jax.profiler.start_trace(profile_dir)
-                    trace_active = True
-                    print(f"[trainer] tracing -> {profile_dir}")
+                tracer.before_dispatch()
                 metrics = self.run_iteration()
                 iteration += 1
-                if trace_active and iteration >= profile_stop:
-                    jax.tree_util.tree_map(
-                        lambda x: x.block_until_ready(), metrics
-                    )
-                    jax.profiler.stop_trace()
-                    trace_active = False
+                tracer.after_dispatch(metrics)
                 meter.tick(
                     self._iters_per_dispatch
                     * self.ppo.n_steps
@@ -703,8 +688,7 @@ class Trainer:
             if self.config.checkpoint:
                 self.save()
         finally:
-            if trace_active:
-                jax.profiler.stop_trace()
+            tracer.close()
             logger.close()
         return last_record
 
@@ -729,6 +713,11 @@ class Trainer:
         )
         meter = Throughput()
         writer = AsyncCheckpointWriter() if self.config.checkpoint else None
+        # Chunk-granular profile=true: trace profile_iterations whole
+        # chunks post-warmup — one dispatch is one chunk here.
+        tracer = profiling.TraceWindow(
+            self.log_dir, self.config.profile, self.config.profile_iterations
+        )
         last_record: Dict[str, float] = {}
         k = self._fused_chunk
         iteration = 0
@@ -743,7 +732,9 @@ class Trainer:
                     if self._scenario_schedule is not None
                     else None
                 )
+                tracer.before_dispatch()
                 stacked = self.run_chunk()
+                tracer.after_dispatch(stacked)
                 if pending is not None:
                     last_record = (
                         self._drain_chunk(logger, meter, *pending)
@@ -765,6 +756,7 @@ class Trainer:
                 writer.close()  # the final write is durable before return
                 writer = None
         finally:
+            tracer.close()
             if writer is not None:
                 # Unwinding on an error: drain the writer without letting
                 # a secondary write failure mask the original exception.
@@ -997,6 +989,12 @@ class Trainer:
         restored = restore_checkpoint_partial(
             path, self._checkpoint_target()
         )
+        # Owning copies BEFORE the donating dispatch sees this state
+        # (utils.own_restored: msgpack leaves can alias the checkpoint
+        # bytes, and donating an aliased buffer is a use-after-free on
+        # the zero-copy CPU backend — observed as garbage params in a
+        # resumed fused sweep; the single-run path shares the hazard).
+        restored = own_restored(restored)
         self.train_state = self.train_state.replace(
             params=restored["params"],
             opt_state=restored.get("opt_state", self.train_state.opt_state),

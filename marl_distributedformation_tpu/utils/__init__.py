@@ -18,6 +18,7 @@ from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
     device_snapshot,
     latest_checkpoint,
     latest_sweep_state,
+    own_restored,
     restore_checkpoint,
     restore_checkpoint_partial,
     save_checkpoint,

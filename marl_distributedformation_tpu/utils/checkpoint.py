@@ -88,6 +88,35 @@ def _write_atomic(path: Path, target: Any) -> None:
     tmp.replace(path)  # atomic: no torn checkpoints (SURVEY.md §5)
 
 
+def own_restored(tree: Any) -> Any:
+    """Copy every array leaf of a freshly-restored checkpoint tree into
+    a JAX-owned buffer before handing it to a training loop.
+
+    ``msgpack_restore`` returns numpy arrays that can VIEW the decoded
+    checkpoint byte buffer, and the training jits DONATE their state
+    inputs. On the zero-copy CPU backend a donated input buffer can
+    alias that foreign memory — once the restore scope drops the bytes,
+    the donated buffer is a use-after-free that later host allocations
+    (the async writer serializing the next checkpoint was the observed
+    scribbler) corrupt silently: a resumed fused-sweep run produced
+    garbage params leaves while every intermediate comparison looked
+    clean (tests/test_fused_sweep.py pins the fixed behavior). One
+    explicit owning copy per leaf at restore time closes the hazard on
+    every backend; non-array leaves (step counters, name strings) pass
+    through untouched.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def leaf(x: Any) -> Any:
+        if isinstance(x, (np.ndarray, jax.Array)):
+            return jnp.array(np.asarray(x))
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 def device_snapshot(target: Any) -> Any:
     """Device-side copy of every array leaf of a checkpoint target.
 
@@ -130,19 +159,30 @@ class AsyncCheckpointWriter:
         """Queue one atomic write of ``target`` to ``path``. ``target``
         must already be safe to read from another thread (host arrays, or
         a :func:`device_snapshot` the caller's donation cannot touch)."""
-        self.wait()
         path = Path(path)
+        self.submit_write(lambda: _write_atomic(path, target))
+        return path
+
+    def submit_write(self, write_fn: Any) -> None:
+        """Queue an arbitrary checkpoint-writing callable on the writer
+        thread — the population sweeps use this to land a whole logical
+        checkpoint (per-member files + the ``sweep_state`` anchor) as one
+        single-flight unit. ``write_fn`` must only touch state that is
+        safe to read off-thread (host arrays / a :func:`device_snapshot`)
+        and must keep :func:`_write_atomic`'s torn-write invariant for
+        every file it produces. Same pipeline contract as :meth:`submit`:
+        one write in flight, errors surface on the next submit/close."""
+        self.wait()
         thread = threading.Thread(
-            target=self._run, args=(path, target),
+            target=self._run, args=(write_fn,),
             daemon=True, name="ckpt-writer",
         )
         self._thread = thread
         thread.start()
-        return path
 
-    def _run(self, path: Path, target: Any) -> None:
+    def _run(self, write_fn: Any) -> None:
         try:
-            _write_atomic(path, target)
+            write_fn()
         except BaseException as e:  # noqa: BLE001 — surfaced on wait()
             self._error = e
 

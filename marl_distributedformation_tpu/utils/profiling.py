@@ -31,6 +31,79 @@ from marl_distributedformation_tpu.analysis.guards import (  # noqa: F401
 )
 
 
+class TraceWindow:
+    """Dispatch-grained ``jax.profiler`` capture window for training
+    loops (the ``profile=true`` implementation shared by the host-loop,
+    fused-scan, and population-sweep drivers).
+
+    The unit is one *dispatch* — a single iteration in the host loop, a
+    whole fused chunk in Anakin mode — so ``profile=true`` composes with
+    ``fused_chunk``: tracing ``count`` dispatches captures ``count``
+    chunks (K iterations each) instead of fail-fasting. The first
+    ``skip`` dispatches are excluded (they are compile-bound and would
+    dominate the trace), and the window closes after syncing the last
+    traced dispatch's outputs so the trace contains the full device
+    execution, not just the async enqueue.
+
+    Start/stop never touch the jit cache — a traced run compiles exactly
+    as often as an untraced one (pinned by the profiler-under-fused
+    smoke tests).
+    """
+
+    def __init__(
+        self,
+        log_dir: Optional[str],
+        enabled: bool,
+        count: int = 3,
+        skip: int = 1,
+    ) -> None:
+        import os
+
+        self.trace_dir = (
+            os.path.join(log_dir, "profile") if log_dir else None
+        )
+        self.enabled = bool(enabled) and self.trace_dir is not None
+        self.count = max(1, int(count))
+        self.skip = max(0, int(skip))
+        self._dispatches = 0
+        self._traced = 0
+        self.active = False
+        self.captured = False
+
+    def before_dispatch(self) -> None:
+        """Open the window once the warmup dispatches have passed."""
+        if (
+            self.enabled
+            and not self.captured
+            and not self.active
+            and self._dispatches >= self.skip
+        ):
+            jax.profiler.start_trace(self.trace_dir)
+            self.active = True
+            print(f"[profile] tracing -> {self.trace_dir}")
+
+    def after_dispatch(self, sync_tree: Optional[object] = None) -> None:
+        """Count the dispatch; once ``count`` traced dispatches are in,
+        block on ``sync_tree`` (the dispatch's outputs) and stop."""
+        self._dispatches += 1
+        if not self.active:
+            return
+        self._traced += 1
+        if self._traced >= self.count:
+            if sync_tree is not None:
+                jax.block_until_ready(sync_tree)
+            jax.profiler.stop_trace()
+            self.active = False
+            self.captured = True
+
+    def close(self) -> None:
+        """Teardown guard for error paths: stop an open trace so the
+        profiler session never leaks across runs."""
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+
+
 @contextlib.contextmanager
 def trace(log_dir: Optional[str]) -> Iterator[None]:
     """Capture a ``jax.profiler`` trace into ``log_dir`` (no-op if None)."""

@@ -310,16 +310,16 @@ def test_async_writer_single_flight_is_ordered(tmp_path):
 
 
 def test_fused_chunk_fail_fasts(tmp_path):
-    from marl_distributedformation_tpu.train import (
-        HeteroTrainer,
-        SweepTrainer,
-    )
+    """The remaining non-composing combos stay loud. profile=true and
+    the population sweeps COMPOSE now (tests/test_fused_sweep.py and
+    test_profile_composes_with_fused_trainer below)."""
+    from marl_distributedformation_tpu.train import HeteroTrainer
 
     with pytest.raises(SystemExit, match="exactly one"):
         make_trainer(tmp_path, fused_chunk=2, iters_per_dispatch=2)
-    with pytest.raises(SystemExit, match="profile"):
-        make_trainer(tmp_path, fused_chunk=2, profile=True)
     with pytest.raises(SystemExit, match="fused_chunk"):
+        # The single-run curriculum trainer keeps its host-driven stage
+        # loop (the POPULATION curriculum shell is the one that fuses).
         HeteroTrainer(
             env_params=EnvParams(num_agents=3),
             ppo=PPO,
@@ -328,13 +328,25 @@ def test_fused_chunk_fail_fasts(tmp_path):
                 log_dir=str(tmp_path / "h"), fused_chunk=2,
             ),
         )
-    with pytest.raises(SystemExit, match="fused_chunk"):
-        SweepTrainer(
-            EnvParams(num_agents=3),
-            ppo=PPO,
-            config=TrainConfig(
-                num_formations=4, name="s", checkpoint=False,
-                log_dir=str(tmp_path / "s"), fused_chunk=2,
-            ),
-            num_seeds=2,
-        )
+
+
+def test_profile_composes_with_fused_trainer(tmp_path):
+    """profile=true + fused_chunk: chunk-granular trace captured into
+    {log_dir}/profile/ with ZERO extra compiles (the combination used
+    to fail-fast)."""
+    trainer = make_trainer(
+        tmp_path,
+        fused_chunk=2,
+        total_timesteps=4 * 3 * 4 * 4,  # 4 iterations = 2 chunks
+        profile=True,
+        profile_iterations=1,
+        guard_retraces=1,
+    )
+    trainer.train()
+    profile_dir = pathlib.Path(trainer.log_dir) / "profile"
+    assert any(p.is_file() for p in profile_dir.rglob("*")), (
+        f"no profiler trace captured under {profile_dir}"
+    )
+    assert trainer.retrace_guard.count == 1, (
+        "tracing must not retrace the fused program"
+    )

@@ -524,6 +524,89 @@ FIXTURES = [
             return state
         """,
     ),
+    (
+        "scan-carry-sharding-drift",
+        """
+        import functools
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train(state, xs):
+            def body(carry, x):
+                h = carry + x
+                h = lax.with_sharding_constraint(h, P())  # drifted
+                return h, h
+            init = lax.with_sharding_constraint(state, P("dp"))
+            return lax.scan(body, init, xs)
+
+        def shadowed(state, xs):
+            # the body REUSES the init's name — its rebind is a
+            # different scope and must not mask the init's spec
+            state = lax.with_sharding_constraint(state, P("dp"))
+            def walk(carry, x):
+                state = lax.with_sharding_constraint(carry + x, P())
+                return state, state
+            return lax.scan(walk, state, xs)
+        """,
+        """
+        import functools
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def other(x):
+            # sibling function binding the same name at another spec:
+            # never poisons train's init lookup
+            init = lax.with_sharding_constraint(x, P(None))
+            return init
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train(state, xs):
+            def body(carry, x):
+                h = lax.with_sharding_constraint(carry + x, P("dp"))
+                return h, h
+            init = lax.with_sharding_constraint(state, P("dp"))
+            return lax.scan(body, init, xs)
+
+        def train2(state, xs):
+            def walk(carry, x):
+                h = lax.with_sharding_constraint(carry + x, P("dp"))
+                return h, h
+            # init unannotated: propagation decides both consistently
+            return lax.scan(walk, state, xs)
+        """,
+    ),
+    (
+        "scan-carry-sharding-drift",
+        """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def step(nn_params, acc, xs):
+            p0 = lax.with_sharding_constraint(nn_params, P("dp"))
+            def body(carry, x):
+                p, a = carry
+                p = lax.with_sharding_constraint(p, P(None))  # drifted
+                return (p, a + x), a
+            return lax.scan(body, (p0, acc), xs)
+        """,
+        """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def step(nn_params, acc, xs):
+            p0 = lax.with_sharding_constraint(nn_params, P("dp"))
+            def body(carry, x):
+                p, a = carry
+                p = lax.with_sharding_constraint(p, P("dp"))
+                return (p, a + x), a
+            return lax.scan(body, (p0, acc), xs)
+        """,
+    ),
 ]
 
 

@@ -411,29 +411,17 @@ def test_sweep_composes_with_ctde_and_gnn(tmp_path):
     assert np.isfinite(np.asarray(m["loss"])).all()
 
 
-@pytest.mark.slow
-def test_sweep_iters_per_dispatch_matches_single(tmp_path):
-    """The scan-fused dispatch (iters_per_dispatch=2) advances the
-    population like two single dispatches; curriculum rejects the knob."""
+def test_sweep_burst_retired_and_hetero_rejects_dispatch_fusion(tmp_path):
+    """iters_per_dispatch (the reduced-metrics burst) is RETIRED for
+    sweeps — fused_chunk is the population fusion spelling
+    (tests/test_fused_sweep.py pins its bitwise parity); the single-run
+    curriculum trainer still rejects both knobs (host-driven stages)."""
     params = EnvParams(num_agents=3)
-    single = SweepTrainer(
-        params, ppo=PPO, config=_cfg(tmp_path), num_seeds=2
-    )
-    burst = SweepTrainer(
-        params, ppo=PPO, config=_cfg(tmp_path, iters_per_dispatch=2),
-        num_seeds=2,
-    )
-    m0 = single.run_iteration()
-    m1 = single.run_iteration()
-    mb = burst.run_iteration()
-    assert single.num_timesteps == burst.num_timesteps
-    _leaves_allclose(single.train_state.params, burst.train_state.params)
-    np.testing.assert_allclose(
-        np.asarray(mb["reward"]),
-        (np.asarray(m0["reward"]) + np.asarray(m1["reward"])) / 2,
-        rtol=1e-5,
-    )
-    assert mb["reward"].shape == (2,)  # member axis survives the burst
+    with pytest.raises(SystemExit, match="fused_chunk"):
+        SweepTrainer(
+            params, ppo=PPO, config=_cfg(tmp_path, iters_per_dispatch=2),
+            num_seeds=2,
+        )
 
     from marl_distributedformation_tpu.train import HeteroTrainer
 

@@ -60,10 +60,15 @@ def train_config_from_config(cfg) -> TrainConfig:
         resume=cfg.get("resume", False),
         log_interval=cfg.log_interval,
         profile=bool(cfg.get("profile", False)),
+        # Dispatches to trace under profile=true — whole fused chunks in
+        # Anakin mode (chunk-granular capture, docs/profiling.md).
+        profile_iterations=int(cfg.get("profile_iterations", 3)),
         iters_per_dispatch=int(cfg.get("iters_per_dispatch", 1)),
         # Anakin mode (docs/training.md): K iterations per lax.scan
         # dispatch, stacked metrics drained double-buffered, checkpoints
         # on a background writer. fused_chunk=32 is a good TPU default.
+        # Composes with num_seeds>1 population sweeps AND curriculum
+        # populations (chunks clip at stage boundaries).
         fused_chunk=int(cfg.get("fused_chunk", 0)),
         # Runtime tracing guards (analysis/guards.py): guard_retraces=1
         # enforces the compiles-exactly-once contract on the train step.
