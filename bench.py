@@ -36,6 +36,12 @@ Measures, inside one process and one JSON line:
   serving-side number — a 2-replica fleet (serving/fleet/) driven by the
   mixed-size smoke storm on a forced 2-device CPU, measured in a
   subprocess (the multi-device CPU flag must land before backend init).
+- ``promotion_latency_s_p50``/``p95`` + ``gate_eval_steps_per_sec``: the
+  always-learning pipeline (pipeline/, scripts/always_learning.py) run
+  end to end — trainer streaming checkpoints through the promotion gate
+  into a 2-replica fleet; latency is train-step -> served ``model_step``
+  wall time, with the gate's one-compile receipt
+  (``pipeline_gate_compiles``) alongside.
 
 Hardened against the flaky axon tunnel (round-1 failure mode: the first
 device op hung for minutes and the round recorded nothing):
@@ -57,7 +63,8 @@ rungs), BENCH_SWEEP_SEEDS, BENCH_SWEEP_M, BENCH_SWEEP_REPEATS
 (interleaved best-of passes per rung, default 5), BENCH_SKIP_SWEEP=1,
 BENCH_FORCE_CPU=1, BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1,
 BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1, BENCH_SKIP_SERVING=1,
-BENCH_SERVING_DURATION_S.
+BENCH_SERVING_DURATION_S, BENCH_SKIP_PIPELINE=1, BENCH_PIPELINE_M,
+BENCH_PIPELINE_GATE_M, BENCH_PIPELINE_BUDGET_S.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -1109,6 +1116,92 @@ def main() -> None:
                     notes.append(f"serving phase failed: {e!r}"[:200])
             else:
                 notes.append("serving phase skipped: deadline")
+        # Phase 7 — the always-learning pipeline (pipeline/,
+        # docs/pipeline.md): trainer -> promotion gate -> fleet as ONE
+        # loop, in a subprocess on a forced 2-device CPU (same rationale
+        # as phase 6 — host-path control-plane numbers; the multi-device
+        # flag must land before backend init). Records the train-step ->
+        # served-model_step wall time (p50/p95 over the run's
+        # promotions), the gate's eval throughput, and the compile-once
+        # receipts: the gate's whole candidate series must cost ONE eval
+        # compile, and serving must stay at <= 1 compile per rung.
+        if os.environ.get("BENCH_SKIP_PIPELINE") != "1":
+            if time.time() < deadline - 90:
+                try:
+                    pipeline_budget = min(
+                        float(
+                            os.environ.get("BENCH_PIPELINE_BUDGET_S", 240.0)
+                        ),
+                        max(deadline - time.time() - 10, 60),
+                    )
+                    cmd = [
+                        sys.executable,
+                        os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "always_learning.py",
+                        ),
+                        "name=bench_pipeline",
+                        f"num_formation={_env_int('BENCH_PIPELINE_M', 16)}",
+                        "total_timesteps=4800",
+                        "max_steps=60",
+                        "log_interval=100",
+                        f"gate_formations="
+                        f"{_env_int('BENCH_PIPELINE_GATE_M', 32)}",
+                        "pipeline_replicas=2",
+                        f"pipeline_budget_s={pipeline_budget}",
+                    ]
+                    env = dict(os.environ)
+                    env["JAX_PLATFORMS"] = "cpu"
+                    env["XLA_FLAGS"] = (
+                        env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                    ).strip()
+                    out = subprocess.run(
+                        cmd, capture_output=True, text=True,
+                        timeout=max(deadline - time.time(), 90),
+                        env=env,
+                    )
+                    if out.returncode != 0:
+                        raise RuntimeError(
+                            f"pipeline run exited {out.returncode}: "
+                            + out.stderr[-200:]
+                        )
+                    rep = json.loads(out.stdout.strip().splitlines()[-1])
+                    p50 = rep.get("promotion_latency_s_p50")
+                    p95 = rep.get("promotion_latency_s_p95")
+                    if p50 is None or p95 is None:
+                        raise RuntimeError(
+                            "pipeline run produced no measured "
+                            f"promotions: {rep}"
+                        )
+                    result["promotion_latency_s_p50"] = round(p50, 3)
+                    result["promotion_latency_s_p95"] = round(p95, 3)
+                    result["gate_eval_steps_per_sec"] = round(
+                        rep["gate_eval_steps_per_sec"], 1
+                    )
+                    result["pipeline_promotions"] = int(rep["promotions"])
+                    result["pipeline_rejections"] = int(rep["rejections"])
+                    # Compile-once receipts: ONE gate eval program across
+                    # every candidate, <= 1 serving compile per rung.
+                    result["pipeline_gate_compiles"] = int(
+                        rep["gate_eval_compiles"]
+                    )
+                    result["pipeline_serving_max_compiles_per_rung"] = int(
+                        rep["serving_max_compiles_per_rung"]
+                    )
+                    print(
+                        "[bench] pipeline (train->gate->fleet, 2-replica "
+                        f"CPU): {rep['promotions']} promotions, "
+                        f"latency p50 {p50:.2f}s / p95 {p95:.2f}s, gate "
+                        f"{rep['gate_eval_steps_per_sec']:,.0f} "
+                        f"eval-steps/s ({rep['gate_eval_compiles']} "
+                        "compile)",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade, don't die
+                    notes.append(f"pipeline phase failed: {e!r}"[:200])
+            else:
+                notes.append("pipeline phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

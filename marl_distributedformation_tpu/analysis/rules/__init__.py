@@ -14,6 +14,9 @@ from marl_distributedformation_tpu.analysis.rules.capture import (
 from marl_distributedformation_tpu.analysis.rules.control_flow import (
     TracedPythonControlFlow,
 )
+from marl_distributedformation_tpu.analysis.rules.cross_module import (
+    CrossModuleCallback,
+)
 from marl_distributedformation_tpu.analysis.rules.deprecated import DeprecatedApi
 from marl_distributedformation_tpu.analysis.rules.donation import MissingDonate
 from marl_distributedformation_tpu.analysis.rules.f64_promotion import (
@@ -47,6 +50,7 @@ RULES = (
     ImplicitF64Promotion(),
     CallbackInHotLoop(),
     ScanCarryShardingDrift(),
+    CrossModuleCallback(),
 )
 
 

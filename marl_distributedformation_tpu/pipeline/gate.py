@@ -1,0 +1,328 @@
+"""PromotionGate: the quality door between training and serving.
+
+Every candidate checkpoint runs through the SAME compiled eval program
+(``scenarios.matrix.MatrixProgram`` — model params and scenario params
+are traced inputs, so the program compiles exactly once for the life of
+the gate; the budget-1 RetraceGuard receipt spans every candidate of an
+always-learning run) and is judged on two axes:
+
+- **Clean-return regression** vs the currently-served baseline: a
+  candidate whose clean-env ``episode_return_per_agent`` falls more than
+  ``clean_tolerance`` (relative) below the served checkpoint's is
+  rejected — training divergence, a corrupted file (NaN params evaluate
+  to NaN returns, which never pass the finite check), or a genuinely
+  worse policy all land here.
+- **Severity-rung regression** on the robustness matrix: for each
+  configured scenario x severity cell, the candidate may not fall more
+  than ``rung_tolerance`` (relative) below the baseline's cell — a
+  policy that got better on the clean env by sacrificing robustness is
+  caught at the rung that regressed.
+
+The first loadable candidate bootstraps the baseline (there is nothing
+served to regress against); thereafter :meth:`PromotionGate.accept`
+installs each promoted candidate's already-computed cells as the new
+baseline — promotion never re-evaluates anything. ``rebase(step)``
+reverts the baseline after a rollback so later candidates are judged
+against what is actually serving again.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.eval import episode_length
+from marl_distributedformation_tpu.utils.checkpoint import checkpoint_step
+
+# Cells: {scenario: {"{severity:g}": {metric: float}}}
+Cells = Dict[str, Dict[str, Dict[str, float]]]
+
+
+@dataclasses.dataclass(frozen=True)
+class GateConfig:
+    """What the gate evaluates and how much regression it tolerates."""
+
+    scenarios: Tuple[str, ...] = ("wind", "sensor_noise")
+    severities: Tuple[float, ...] = (0.5, 1.0)
+    eval_formations: int = 256
+    eval_seed: int = 1234
+    deterministic: bool = True
+    metric: str = "episode_return_per_agent"
+    clean_tolerance: float = 0.05  # relative clean-return slack vs served
+    rung_tolerance: float = 0.10  # relative per-cell slack vs served
+
+
+@dataclasses.dataclass
+class GateVerdict:
+    """One candidate's judgment — everything ``promotions.jsonl`` needs."""
+
+    step: int
+    path: str
+    passed: bool
+    reasons: List[str]  # empty iff passed
+    clean: Dict[str, float]
+    cells: Cells
+    baseline_step: Optional[int]
+    eval_compiles: int
+    eval_seconds: float
+
+    def record(self) -> dict:
+        """The flat payload logged per candidate (PromotionLog adds
+        schema/event/time)."""
+        return {
+            "step": self.step,
+            "checkpoint": self.path,
+            "passed": self.passed,
+            "reasons": list(self.reasons),
+            "clean": self.clean,
+            "cells": self.cells,
+            "baseline_step": self.baseline_step,
+            "gate_eval_compiles": self.eval_compiles,
+            "gate_eval_seconds": round(self.eval_seconds, 4),
+        }
+
+
+def _relative_regression(candidate: float, baseline: float) -> float:
+    """Scale-free drop of ``candidate`` below ``baseline`` (positive =
+    worse). Denominated on |baseline| with a floor of 1 so a
+    near-zero baseline cannot turn noise into infinity."""
+    return (baseline - candidate) / max(abs(baseline), 1.0)
+
+
+def judge_candidate(
+    metric: str,
+    clean: Dict[str, float],
+    cells: Cells,
+    baseline_clean: Optional[Dict[str, float]],
+    baseline_cells: Optional[Cells],
+    clean_tolerance: float,
+    rung_tolerance: float,
+) -> List[str]:
+    """Pure verdict logic: the list of rejection reasons (empty = pass).
+
+    Separated from the gate so the rejection taxonomy is unit-testable
+    without a single eval (tests/test_pipeline.py feeds it synthetic
+    numbers for every branch).
+    """
+    reasons: List[str] = []
+    outputs = [clean] + [
+        m for per_sev in cells.values() for m in per_sev.values()
+    ]
+    missing = [m for m in outputs if metric not in m]
+    if missing and any(m for m in outputs):
+        # The eval ran and emitted metrics, just not THIS one: a config
+        # typo, not corruption — name the fix, don't blame the params.
+        emitted = sorted({k for m in outputs for k in m})
+        reasons.append(
+            f"gate metric {metric!r} absent from eval output (emitted: "
+            f"{', '.join(emitted)}) — check the gate metric config"
+        )
+        return reasons
+    values = [m.get(metric, math.nan) for m in outputs]
+    if not all(math.isfinite(v) for v in values):
+        reasons.append(
+            f"non-finite {metric} in candidate eval (corrupted or "
+            "diverged parameters)"
+        )
+        return reasons  # NaN poisons every comparison below; stop here
+    if baseline_clean is None:
+        return reasons  # bootstrap: nothing served to regress against
+    drop = _relative_regression(
+        clean.get(metric, math.nan), baseline_clean.get(metric, math.nan)
+    )
+    if not math.isfinite(drop) or drop > clean_tolerance:
+        reasons.append(
+            f"clean {metric} regressed {drop * 100.0:.1f}% vs served "
+            f"baseline (tolerance {clean_tolerance * 100.0:.1f}%)"
+        )
+    for scenario, per_sev in cells.items():
+        base_sev = (baseline_cells or {}).get(scenario, {})
+        for sev, metrics in per_sev.items():
+            base = base_sev.get(sev)
+            if base is None:
+                continue  # no baseline cell: nothing to regress against
+            drop = _relative_regression(
+                metrics.get(metric, math.nan), base.get(metric, math.nan)
+            )
+            if not math.isfinite(drop) or drop > rung_tolerance:
+                reasons.append(
+                    f"severity rung {scenario}@{sev} {metric} regressed "
+                    f"{drop * 100.0:.1f}% vs served baseline (tolerance "
+                    f"{rung_tolerance * 100.0:.1f}%)"
+                )
+    return reasons
+
+
+class PromotionGate:
+    """Judge candidates against the served baseline with one compiled
+    eval program.
+
+    The program is built lazily from the FIRST loadable candidate (the
+    checkpoint records its own architecture) and reused for every later
+    one; a candidate with a different architecture is a rejection, not a
+    recompile (``MatrixProgram.check_params``).
+    """
+
+    def __init__(
+        self, env_params: EnvParams, config: GateConfig = GateConfig()
+    ) -> None:
+        self.env_params = env_params
+        self.config = config
+        self.program = None  # scenarios.matrix.MatrixProgram, lazy
+        self._baseline_step: Optional[int] = None
+        self._baseline_clean: Optional[Dict[str, float]] = None
+        self._baseline_cells: Optional[Cells] = None
+        # Promoted-step history so a rollback can rebase the comparison
+        # point without re-evaluating (bounded: serving history is short).
+        self._history: Dict[int, Tuple[Dict[str, float], Cells]] = {}
+        self._history_order: List[int] = []
+        self.eval_seconds_total = 0.0
+        self.cells_evaluated = 0
+
+    # -- evaluation ------------------------------------------------------
+
+    @property
+    def baseline_step(self) -> Optional[int]:
+        return self._baseline_step
+
+    def evaluate(self, path: str | Path) -> GateVerdict:
+        """Run one candidate through the matrix + regression checks.
+        Never raises for a bad candidate — unloadable / wrong-
+        architecture / non-finite candidates are failed verdicts with
+        the reason recorded."""
+        from marl_distributedformation_tpu.compat.policy import LoadedPolicy
+        from marl_distributedformation_tpu.scenarios.matrix import (
+            MatrixProgram,
+        )
+
+        path = Path(path)
+        cfg = self.config
+        try:
+            step = checkpoint_step(path)
+        except ValueError as e:
+            # Not a checkpoint-shaped filename — unreachable via the
+            # stream (regex-filtered) but a direct caller still gets a
+            # rejected verdict, not an exception.
+            return GateVerdict(
+                step=-1,
+                path=str(path),
+                passed=False,
+                reasons=[f"not a checkpoint path: {e!r}"],
+                clean={},
+                cells={},
+                baseline_step=self._baseline_step,
+                eval_compiles=(
+                    self.program.compile_count if self.program else 0
+                ),
+                eval_seconds=0.0,
+            )
+        try:
+            pol = LoadedPolicy.from_checkpoint(
+                path,
+                act_dim=self.env_params.act_dim,
+                env_params=self.env_params,
+            )
+            if self.program is None:
+                self.program = MatrixProgram(
+                    pol.model,
+                    self.env_params,
+                    num_formations=cfg.eval_formations,
+                    deterministic=cfg.deterministic,
+                    seed=cfg.eval_seed,
+                )
+            t0 = time.perf_counter()
+            clean = self.program.evaluate_clean(pol.params, origin=str(path))
+            cells = self.program.evaluate_cells(
+                pol.params, cfg.scenarios, cfg.severities, origin=str(path)
+            )
+        except Exception as e:  # noqa: BLE001 — a bad candidate must
+            # never kill the pipeline; it is a rejected verdict.
+            return GateVerdict(
+                step=step,
+                path=str(path),
+                passed=False,
+                reasons=[f"candidate failed to load/evaluate: {e!r}"],
+                clean={},
+                cells={},
+                baseline_step=self._baseline_step,
+                eval_compiles=(
+                    self.program.compile_count if self.program else 0
+                ),
+                eval_seconds=0.0,
+            )
+        seconds = time.perf_counter() - t0
+        self.eval_seconds_total += seconds
+        self.cells_evaluated += 1 + len(cfg.scenarios) * len(cfg.severities)
+        reasons = judge_candidate(
+            cfg.metric,
+            clean,
+            cells,
+            self._baseline_clean,
+            self._baseline_cells,
+            cfg.clean_tolerance,
+            cfg.rung_tolerance,
+        )
+        return GateVerdict(
+            step=step,
+            path=str(path),
+            passed=not reasons,
+            reasons=reasons,
+            clean=clean,
+            cells=cells,
+            baseline_step=self._baseline_step,
+            eval_compiles=self.program.compile_count,
+            eval_seconds=seconds,
+        )
+
+    # -- baseline management ---------------------------------------------
+
+    def accept(self, verdict: GateVerdict, keep_history: int = 8) -> None:
+        """Install a PROMOTED candidate's already-computed evals as the
+        new comparison baseline (no re-eval, ever)."""
+        assert verdict.passed, "only promoted candidates become baselines"
+        self._baseline_step = verdict.step
+        self._baseline_clean = verdict.clean
+        self._baseline_cells = verdict.cells
+        self._history[verdict.step] = (verdict.clean, verdict.cells)
+        self._history_order.append(verdict.step)
+        while len(self._history_order) > keep_history:
+            dropped = self._history_order.pop(0)
+            if dropped != self._baseline_step:
+                self._history.pop(dropped, None)
+
+    def rebase(self, step: int) -> None:
+        """After a rollback: judge future candidates against the
+        checkpoint that is serving AGAIN. A step evicted from the
+        bounded history (a demotion cascade longer than
+        ``keep_history``) degrades to bootstrap judging — finite
+        candidates pass until the next promotion re-establishes a real
+        baseline — rather than crashing the control plane."""
+        entry = self._history.get(step)
+        if entry is None:
+            self._baseline_step = step
+            self._baseline_clean = None
+            self._baseline_cells = None
+            return
+        clean, cells = entry
+        self._baseline_step = step
+        self._baseline_clean = clean
+        self._baseline_cells = cells
+
+    # -- observability ---------------------------------------------------
+
+    def eval_steps_per_sec(self) -> float:
+        """Gate throughput in formation-env-steps evaluated per second
+        (cells x formations x episode length over cumulative eval
+        wall-clock) — the bench's ``gate_eval_steps_per_sec``."""
+        if self.eval_seconds_total <= 0:
+            return 0.0
+        steps = (
+            self.cells_evaluated
+            * self.config.eval_formations
+            * episode_length(self.env_params)
+        )
+        return steps / self.eval_seconds_total

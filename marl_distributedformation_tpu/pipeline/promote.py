@@ -1,0 +1,113 @@
+"""Promoter + PromotionLog: publication and the audit trail.
+
+The fleet's reload coordinator must only ever see VETTED checkpoints —
+pointing it at the trainer's own directory would serve candidates the
+gate has not judged yet. The Promoter therefore owns a separate
+``promoted/`` directory: passing checkpoints are published into it with
+the same atomic-rename discipline the trainer uses (hardlink or copy to
+a dot-prefixed temp name, then ``os.replace``), the original
+``rl_model_{steps}_steps`` naming preserved so every discovery/step
+contract keeps working, and the coordinator watches ONLY this
+directory. ``retract_above`` is the rollback half: demoted checkpoints
+are removed so the coordinator's next poll cannot re-promote them.
+
+``PromotionLog`` is the versioned ``promotions.jsonl`` verdict log: one
+JSON object per line, schema-stamped, append-only — the audit trail of
+every promote / reject / rollback decision the pipeline ever made.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List
+
+from marl_distributedformation_tpu.utils.checkpoint import checkpoint_step
+
+# Bump when the line shape changes; scripts/check_bench_record.py and the
+# schema unit test pin the current shape.
+PROMOTIONS_SCHEMA = 1
+
+
+class PromotionLog:
+    """Append-only JSONL verdict log. Every line carries ``schema``,
+    ``event`` (``promoted`` / ``rejected`` / ``rolled_back``), and
+    ``time`` (epoch seconds); the rest is the event's payload."""
+
+    def __init__(self, path: str | Path) -> None:
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def append(self, event: str, **fields) -> dict:
+        record = {
+            "schema": PROMOTIONS_SCHEMA,
+            "event": event,
+            "time": round(time.time(), 3),
+            **fields,
+        }
+        line = json.dumps(record)
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+        return record
+
+    @staticmethod
+    def read(path: str | Path) -> List[dict]:
+        p = Path(path)
+        if not p.exists():
+            return []
+        return [
+            json.loads(line)
+            for line in p.read_text().splitlines()
+            if line.strip()
+        ]
+
+
+class Promoter:
+    """Publish passing checkpoints into the coordinator-watched
+    directory; retract demoted ones."""
+
+    def __init__(self, promoted_dir: str | Path) -> None:
+        self.promoted_dir = Path(promoted_dir)
+        self.promoted_dir.mkdir(parents=True, exist_ok=True)
+
+    def publish(self, source: str | Path) -> Path:
+        """Atomically land ``source`` in the promoted directory under
+        its own name. Hardlink when the filesystem allows (zero-copy —
+        the trainer's file IS the promoted file), bytewise copy
+        otherwise; either way the visible name appears complete-or-not
+        via ``os.replace``, the same torn-write invariant as
+        ``_write_atomic``."""
+        source = Path(source)
+        dst = self.promoted_dir / source.name
+        tmp = self.promoted_dir / f".{source.name}.tmp"
+        tmp.unlink(missing_ok=True)
+        try:
+            os.link(source, tmp)
+        except OSError:  # cross-device / no-hardlink filesystem
+            shutil.copyfile(source, tmp)
+        os.replace(tmp, dst)
+        return dst
+
+    def retract_above(self, step: int) -> List[Path]:
+        """Remove every promoted checkpoint with a step strictly above
+        ``step`` (the rollback path: a demoted checkpoint must not be
+        re-promotable by the coordinator's next poll). Returns what was
+        removed."""
+        removed: List[Path] = []
+        for p in sorted(self.promoted_dir.glob("rl_model_*_steps.msgpack")):
+            if checkpoint_step(p) > step:
+                p.unlink(missing_ok=True)
+                removed.append(p)
+        return removed
+
+    def published_steps(self) -> Dict[int, Path]:
+        return {
+            checkpoint_step(p): p
+            for p in self.promoted_dir.glob("rl_model_*_steps.msgpack")
+        }

@@ -1,0 +1,425 @@
+"""AlwaysLearningPipeline: the control plane over trainer, gate, fleet.
+
+The loop (docs/pipeline.md has the full state machine):
+
+    trainer writes logs/{name}/rl_model_*  ──►  CheckpointStream
+        │ new candidate, step order
+        ▼
+    PromotionGate.evaluate  ── reject ──►  promotions.jsonl "rejected"
+        │ pass
+        ▼
+    Promoter.publish ──► promoted/ ──► FleetReloadCoordinator.refresh
+        │ fleet serves the step (globally monotonic model_step)
+        ▼
+    promotions.jsonl "promoted" (+ promotion_latency_s)
+        ▲
+    RollbackMonitor regression  ──►  demote: retract above last-good,
+        reload_pinned(last-good, monotonic=False), gate.rebase,
+        promotions.jsonl "rolled_back"
+
+Everything is driven by explicit ``poll_once()`` calls — deterministic
+for tests — and ``run()`` wraps them in the background loop the CLI
+uses. The fleet attaches AFTER the first promotion exists (a fleet
+cannot boot from an empty promoted directory); until then passing
+candidates are published and the verdicts logged, so
+``wait_first_promotion`` + ``fleet_from_checkpoint_dir(promoted_dir)``
+is the bootstrap sequence (scripts/always_learning.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.pipeline.gate import (
+    GateConfig,
+    GateVerdict,
+    PromotionGate,
+)
+from marl_distributedformation_tpu.pipeline.promote import (
+    Promoter,
+    PromotionLog,
+)
+from marl_distributedformation_tpu.pipeline.rollback import RollbackMonitor
+from marl_distributedformation_tpu.pipeline.stream import CheckpointStream
+
+
+@dataclasses.dataclass
+class PromotionRecord:
+    """One served promotion: where it came from, where it serves from,
+    and how long train-step -> served took."""
+
+    step: int
+    source: str
+    promoted: str
+    latency_s: Optional[float]  # None before a fleet is attached
+
+
+class AlwaysLearningPipeline:
+    """Wire stream -> gate -> promoter -> fleet, with rollback."""
+
+    def __init__(
+        self,
+        log_dir: str | Path,
+        env_params: EnvParams,
+        gate_config: GateConfig = GateConfig(),
+        promoted_dir: Optional[str | Path] = None,
+        poll_interval_s: float = 0.25,
+        start_after_step: int = -1,
+    ) -> None:
+        self.log_dir = Path(log_dir)
+        self.stream = CheckpointStream(
+            self.log_dir,
+            poll_interval_s=poll_interval_s,
+            start_after_step=start_after_step,
+        )
+        self.gate = PromotionGate(env_params, gate_config)
+        self.promoted_dir = Path(
+            promoted_dir if promoted_dir is not None
+            else self.log_dir / "promoted"
+        )
+        self.promoter = Promoter(self.promoted_dir)
+        self.log = PromotionLog(self.log_dir / "promotions.jsonl")
+        self.router: Optional[Any] = None
+        self.coordinator: Optional[Any] = None
+        self.monitor: Optional[RollbackMonitor] = None
+        self.promotions: List[PromotionRecord] = []
+        self.rejections: List[GateVerdict] = []
+        self.rollbacks: List[dict] = []
+        # Candidates discovered but not yet judged (wait_first_promotion
+        # stops at the first pass; the backlog is served once the fleet
+        # is attached, so every later promotion actually swaps).
+        self._pending: List[Path] = []
+        # Published candidates whose fleet commit did NOT land (a wedged
+        # replica aborts the batch-barrier swap) — retried each poll;
+        # they only become promotions when the fleet actually serves
+        # them. Step-ascending by construction.
+        self._deferred: List[tuple] = []
+        # Background-loop errors (run() must survive them, not die
+        # silently) — newest last, surfaced in summary().
+        self.errors: List[str] = []
+        # The serving stack: promoted records still considered good
+        # (rollback pops). Top = what the fleet serves.
+        self._good: List[PromotionRecord] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring ----------------------------------------------------------
+
+    def attach_fleet(self, router: Any, coordinator: Any) -> None:
+        """Hand over the serving side. The coordinator MUST watch the
+        promoted directory — watching the trainer's own directory would
+        serve unvetted candidates, the exact hole this subsystem
+        closes."""
+        if Path(coordinator.log_dir).resolve() != self.promoted_dir.resolve():
+            raise ValueError(
+                f"coordinator watches {coordinator.log_dir}, but only "
+                f"the promoted directory {self.promoted_dir} holds "
+                "vetted checkpoints — build the fleet with "
+                "fleet_from_checkpoint_dir(pipeline.promoted_dir)"
+            )
+        self.router = router
+        self.coordinator = coordinator
+
+    def attach_monitor(self, monitor: RollbackMonitor) -> None:
+        self.monitor = monitor
+
+    def attach_trainer(self, trainer: Any) -> None:
+        """Push-path hookup: the trainer nudges the stream the moment a
+        checkpoint is durable (no poll-interval floor on promotion
+        latency)."""
+        trainer.on_checkpoint = self.stream.nudge
+
+    # -- the loop --------------------------------------------------------
+
+    def process_candidate(self, path: Path) -> GateVerdict:
+        """Gate one candidate; publish + swap + log on pass, log on
+        reject. A passing candidate whose FLEET COMMIT does not land (a
+        wedged replica aborts the barrier swap — reload.py's abort path)
+        is 'promotion_deferred', not 'promoted': the baseline, the
+        good-stack, and the audit log only ever advance to checkpoints
+        that actually serve; the commit is retried on later polls."""
+        verdict = self.gate.evaluate(path)
+        if not verdict.passed:
+            self.rejections.append(verdict)
+            self.log.append("rejected", **verdict.record())
+            return verdict
+        promoted = self.promoter.publish(path)
+        if self.coordinator is not None:
+            self.coordinator.refresh()
+            # refresh() may return False for benign reasons (a started
+            # background watcher raced us to the swap) — what matters is
+            # whether the fleet now serves at least this step.
+            if self.coordinator.fleet_step < verdict.step:
+                self._deferred.append((verdict, str(promoted), path))
+                self.log.append(
+                    "promotion_deferred",
+                    **verdict.record(),
+                    promoted_path=str(promoted),
+                    reason="fleet commit did not land (see coordinator "
+                    "load_errors); retrying on later polls",
+                )
+                return verdict
+            # Served wall-clock: from the moment the trainer's write
+            # became durable (the file's mtime) to the moment every
+            # post-commit dispatch answers with this step.
+            latency = self._latency_since_write(path)
+        else:
+            latency = None
+        self._finalize_promotion(verdict, str(promoted), path, latency)
+        return verdict
+
+    @staticmethod
+    def _latency_since_write(path: Path) -> Optional[float]:
+        try:
+            return max(0.0, time.time() - path.stat().st_mtime)
+        except OSError:  # source pruned after the gate read it — the
+            # promotion stands, only its latency is unmeasurable
+            return None
+
+    def _finalize_promotion(
+        self,
+        verdict: GateVerdict,
+        promoted: str,
+        path: Path,
+        latency: Optional[float],
+    ) -> None:
+        """The candidate SERVES (or no fleet is attached yet): install
+        it as the gate baseline and the new last-good."""
+        self.gate.accept(verdict)
+        record = PromotionRecord(
+            step=verdict.step,
+            source=str(path),
+            promoted=promoted,
+            latency_s=latency,
+        )
+        self.promotions.append(record)
+        self._good.append(record)
+        if self.monitor is not None:
+            self.monitor.reset()
+        self.log.append(
+            "promoted",
+            **verdict.record(),
+            promoted_path=promoted,
+            promotion_latency_s=(
+                round(latency, 4) if latency is not None else None
+            ),
+        )
+
+    def _retry_deferred(self) -> None:
+        """Re-attempt the fleet commit for published-but-unserved
+        candidates. A deferred candidate finalizes ONLY when the fleet
+        serves EXACTLY its step; if the fleet moved past it (refresh
+        always commits the newest published checkpoint, so clearing a
+        wedge with several candidates queued jumps straight to the
+        latest), the older candidate never served and never will — it
+        terminates as 'promotion_superseded', not 'promoted', and never
+        becomes the gate baseline or a rollback target."""
+        if not self._deferred or self.coordinator is None:
+            return
+        self.coordinator.refresh()
+        still_deferred = []
+        for verdict, promoted, path in self._deferred:
+            fleet_step = self.coordinator.fleet_step
+            if fleet_step == verdict.step:
+                self._finalize_promotion(
+                    verdict, promoted, path,
+                    self._latency_since_write(path),
+                )
+            elif fleet_step > verdict.step:
+                self.log.append(
+                    "promotion_superseded",
+                    step=verdict.step,
+                    checkpoint=verdict.path,
+                    reason=f"fleet committed step {fleet_step} while this "
+                    "candidate's swap was deferred; it never served",
+                )
+            else:
+                still_deferred.append((verdict, promoted, path))
+        self._deferred = still_deferred
+
+    def check_rollback(self) -> bool:
+        """One monitor sample; demote to last-good on a tripped
+        regression. Returns True iff a rollback happened."""
+        if (
+            self.monitor is None
+            or self.coordinator is None
+            or len(self._good) < 2
+            # With one good checkpoint there is nothing to demote TO —
+            # an empty fleet is strictly worse than a suspect one.
+        ):
+            return False
+        if not self.monitor.observe():
+            return False
+        bad = self._good.pop()
+        last_good = self._good[-1]
+        entry = {
+            "from_step": bad.step,
+            "to_step": last_good.step,
+            "metric": self.monitor.metric,
+            "value": self.monitor.last_value,
+            "limit": self.monitor.limit(),
+            "baseline": self.monitor.baseline,
+        }
+        # Retract FIRST so a concurrently-polling coordinator cannot
+        # re-promote the demoted step between the swap and the cleanup.
+        # Deferred candidates above last-good lose their published files
+        # here too — terminate them (they can never commit now; leaving
+        # them queued would retry forever and could later finalize a
+        # retracted, never-served checkpoint).
+        self.promoter.retract_above(last_good.step)
+        still_deferred = []
+        for verdict, promoted, path in self._deferred:
+            if verdict.step > last_good.step:
+                self.log.append(
+                    "promotion_superseded",
+                    step=verdict.step,
+                    checkpoint=verdict.path,
+                    reason=f"retracted by the rollback to step "
+                    f"{last_good.step} while its swap was deferred",
+                )
+            else:
+                still_deferred.append((verdict, promoted, path))
+        self._deferred = still_deferred
+        if not self.coordinator.reload_pinned(
+            last_good.promoted, monotonic=False
+        ):
+            # The demotion commit itself failed (wedged replica /
+            # unreadable last-good): the regressed checkpoint is STILL
+            # serving — record that truthfully, restore the good-stack
+            # AND its published file (retract_above already removed it;
+            # without the re-publish, a later rollback TO this record
+            # would pin a nonexistent path forever), and leave the
+            # breach streak alive so the next poll retries
+            # (monitor.reset here would silence the alarm).
+            try:
+                self.promoter.publish(bad.source)
+            except OSError:  # source pruned: the record stays, only
+                pass  # its file is gone — reload_pinned will record it
+            self._good.append(bad)
+            self.log.append(
+                "rollback_failed",
+                **entry,
+                reason="pinned reload did not commit (see coordinator "
+                "load_errors); retrying on later polls",
+            )
+            return False
+        self.gate.rebase(last_good.step)
+        self.monitor.reset()
+        self.rollbacks.append(entry)
+        self.log.append("rolled_back", **entry)
+        return True
+
+    def poll_once(self) -> int:
+        """One supervision step: retry deferred fleet commits, gate
+        every queued + newly-discovered candidate, then sample the
+        rollback monitor once. Returns candidates processed."""
+        self._retry_deferred()
+        self._pending.extend(self.stream.poll())
+        processed = 0
+        while self._pending:
+            self.process_candidate(self._pending.pop(0))
+            processed += 1
+        self.check_rollback()
+        return processed
+
+    def wait_first_promotion(self, timeout_s: float = 60.0) -> bool:
+        """Bootstrap: block until the first candidate PASSES the gate
+        (rejecting failures along the way — one candidate at a time, so
+        everything after the first pass stays queued for the
+        fleet-attached loop). After this the promoted directory is
+        non-empty and a fleet can boot from it."""
+        deadline = time.monotonic() + timeout_s
+        while not self.promotions:
+            if not self._pending:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._pending.extend(self.stream.wait(min(remaining, 5.0)))
+                continue
+            self.process_candidate(self._pending.pop(0))
+        return True
+
+    # -- background loop (the CLI's mode) --------------------------------
+
+    def run(self, interval_s: float = 0.25) -> "AlwaysLearningPipeline":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                # A transient failure (full disk during publish/log, a
+                # checkpoint pruned mid-judgment) must not silently kill
+                # the control plane — record it and keep supervising.
+                try:
+                    self._retry_deferred()
+                    self._pending.extend(self.stream.wait(interval_s))
+                    while self._pending and not self._stop.is_set():
+                        self.process_candidate(self._pending.pop(0))
+                    self.check_rollback()
+                except Exception as e:  # noqa: BLE001
+                    self.errors.append(repr(e))
+                    del self.errors[:-32]  # bounded
+                    self._stop.wait(interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="always-learning-pipeline", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self.stream.nudge()
+        self._thread.join(timeout=30.0)
+        self._thread = None
+
+    def __enter__(self) -> "AlwaysLearningPipeline":
+        return self.run()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- observability ---------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """Flat report (the CLI's JSON line feeds off it)."""
+        latencies = sorted(
+            r.latency_s for r in self.promotions if r.latency_s is not None
+        )
+
+        def pct(q: float) -> Optional[float]:
+            if not latencies:
+                return None
+            idx = min(len(latencies) - 1, int(q * len(latencies)))
+            return round(latencies[idx], 4)
+
+        return {
+            "promotions": len(self.promotions),
+            "rejections": len(self.rejections),
+            "rollbacks": len(self.rollbacks),
+            "deferred_promotions": len(self._deferred),
+            "pipeline_errors": list(self.errors),
+            "served_step": (
+                self.coordinator.fleet_step
+                if self.coordinator is not None
+                else (self._good[-1].step if self._good else None)
+            ),
+            "promotion_latency_s_p50": pct(0.50),
+            "promotion_latency_s_p95": pct(0.95),
+            "gate_eval_steps_per_sec": round(
+                self.gate.eval_steps_per_sec(), 1
+            ),
+            "gate_eval_compiles": (
+                self.gate.program.compile_count
+                if self.gate.program is not None
+                else 0
+            ),
+        }

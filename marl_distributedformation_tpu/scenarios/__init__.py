@@ -38,6 +38,7 @@ from marl_distributedformation_tpu.scenarios.schedule import (  # noqa: F401
     schedule_from_cfg,
 )
 from marl_distributedformation_tpu.scenarios.matrix import (  # noqa: F401
+    MatrixProgram,
     make_matrix_runner,
     run_matrix,
 )

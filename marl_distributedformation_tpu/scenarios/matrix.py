@@ -8,7 +8,14 @@ pinned by a budget-1 ``analysis.guards.RetraceGuard``. Identical initial
 states across every cell (the eval-seed convention of ``eval.py``), so
 cells are directly comparable.
 
-CLI: ``scripts/robustness_matrix.py`` (one JSON report per run).
+:class:`MatrixProgram` is the importable, long-lived form: it owns the
+jitted runner + guard and evaluates arbitrarily many parameter
+candidates over its life without re-jitting — the promotion gate of the
+always-learning pipeline (``pipeline/gate.py``) holds ONE for an entire
+run, so every trained candidate reuses the same compiled program (the
+budget-1 receipt spans all of them). :func:`run_matrix` is the one-shot
+checkpoint-list sweep built on top of it, and the CLI
+(``scripts/robustness_matrix.py``) is a thin wrapper over that.
 """
 
 from __future__ import annotations
@@ -50,6 +57,106 @@ def make_matrix_runner(
     return jax.jit(guard.wrap(episode)), guard
 
 
+def params_signature(params) -> Tuple:
+    """Structure AND leaf shapes/dtypes of a parameter tree. The matrix
+    shares ONE compiled program, so every candidate must match the first
+    one's signature — same-structure checkpoints with different widths
+    would otherwise pass construction, then blow the budget-1 guard
+    mid-sweep with a confusing retrace error."""
+    return jax.tree_util.tree_structure(params), tuple(
+        (jnp.shape(leaf), jnp.asarray(leaf).dtype)
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
+
+
+class MatrixProgram:
+    """The compiled scenario x severity eval program, reusable across
+    candidates.
+
+    Construction jits nothing; the single compile happens on the first
+    evaluated cell and every later cell — any scenario, any severity,
+    any same-architecture parameter tree — reuses it (``guard.count``
+    is the receipt). ``check_params`` enforces the one-architecture
+    contract against the first candidate seen.
+    """
+
+    def __init__(
+        self,
+        model,
+        env_params: EnvParams,
+        num_formations: int = 256,
+        deterministic: bool = True,
+        seed: int = 1234,
+        max_traces: Optional[int] = 1,
+    ) -> None:
+        self.model = model
+        self.env_params = env_params
+        self.num_formations = num_formations
+        self.deterministic = deterministic
+        self.seed = seed
+        self.run, self.guard = make_matrix_runner(
+            model, env_params, num_formations, deterministic, max_traces
+        )
+        self.key = jax.random.PRNGKey(seed)
+        self._signature: Optional[Tuple] = None
+
+    @property
+    def compile_count(self) -> int:
+        """Traces of the shared program so far (the compile-once
+        receipt: stays 1 across every candidate and cell)."""
+        return self.guard.count
+
+    def check_params(self, params, origin: str = "<candidate>") -> None:
+        """Fail fast on a parameter tree the compiled program cannot
+        serve (different structure/shapes/dtypes than the first
+        candidate)."""
+        sig = params_signature(params)
+        if self._signature is None:
+            self._signature = sig
+        elif sig != self._signature:
+            raise ValueError(
+                f"checkpoint {origin} has a different parameter "
+                "structure/shape than the first candidate — the matrix "
+                "shares one compiled program, so all candidates must be "
+                "one architecture (run separate matrices per architecture)"
+            )
+
+    def evaluate_clean(
+        self, params, origin: str = "<candidate>"
+    ) -> Dict[str, float]:
+        """The clean-env episode metrics via the registry's ``clean``
+        scenario at severity 0 — bitwise identical to the raw env
+        (pinned by tests/test_scenarios.py), through the SAME compiled
+        program as every disturbed cell."""
+        self.check_params(params, origin)
+        spec = get_scenario("clean")
+        out = self.run(self.key, params, spec.build(jnp.float32(0.0)))
+        return {k: float(v) for k, v in out.items()}
+
+    def evaluate_cells(
+        self,
+        params,
+        scenarios: Sequence[str],
+        severities: Sequence[float],
+        origin: str = "<candidate>",
+    ) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """The full scenario x severity grid for one parameter tree:
+        ``cells[scenario][f"{severity:g}"] -> metrics``."""
+        self.check_params(params, origin)
+        specs = [get_scenario(str(name)) for name in scenarios]  # fail fast
+        cells: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for spec in specs:
+            per_severity: Dict[str, Dict[str, float]] = {}
+            for severity in severities:
+                sp = spec.build(jnp.float32(severity))
+                out = self.run(self.key, params, sp)
+                per_severity[f"{float(severity):g}"] = {
+                    k: float(v) for k, v in out.items()
+                }
+            cells[spec.name] = per_severity
+        return cells
+
+
 def run_matrix(
     checkpoint_paths: Sequence[str],
     env_params: EnvParams,
@@ -78,43 +185,25 @@ def run_matrix(
         )
         for p in checkpoint_paths
     ]
-    def signature(params):
-        # Structure AND leaf shapes/dtypes: same-structure checkpoints
-        # with different widths would otherwise pass, then blow the
-        # budget-1 guard mid-sweep with a confusing retrace error.
-        return jax.tree_util.tree_structure(params), [
-            (jnp.shape(leaf), jnp.asarray(leaf).dtype)
-            for leaf in jax.tree_util.tree_leaves(params)
-        ]
-
-    reference = signature(policies[0].params)
-    for path, pol in zip(checkpoint_paths, policies):
-        if signature(pol.params) != reference:
-            raise ValueError(
-                f"checkpoint {path} has a different parameter "
-                "structure/shape than the first checkpoint — the matrix "
-                "shares one compiled program, so all checkpoints must be "
-                "one architecture (run separate matrices per architecture)"
-            )
-
-    run, guard = make_matrix_runner(
-        policies[0].model, env_params, num_formations, deterministic
+    program = MatrixProgram(
+        policies[0].model,
+        env_params,
+        num_formations=num_formations,
+        deterministic=deterministic,
+        seed=seed,
     )
-    key = jax.random.PRNGKey(seed)
-
+    # Validate EVERY architecture before the first (expensive) eval cell,
+    # so a mismatched file fails the run up front, by name.
+    for path, pol in zip(checkpoint_paths, policies):
+        program.check_params(pol.params, origin=str(path))
     matrix: Dict[str, Dict[str, Dict[str, Dict[str, float]]]] = {}
     for path, pol in zip(checkpoint_paths, policies):
-        per_scenario: Dict[str, Dict[str, Dict[str, float]]] = {}
-        for spec in specs:
-            per_severity: Dict[str, Dict[str, float]] = {}
-            for severity in severities:
-                sp = spec.build(jnp.float32(severity))
-                out = run(key, pol.params, sp)
-                per_severity[f"{float(severity):g}"] = {
-                    k: float(v) for k, v in out.items()
-                }
-            per_scenario[spec.name] = per_severity
-        matrix[str(path)] = per_scenario
+        matrix[str(path)] = program.evaluate_cells(
+            pol.params,
+            [spec.name for spec in specs],
+            severities,
+            origin=str(path),
+        )
 
     return {
         "scenarios": [spec.name for spec in specs],
@@ -125,5 +214,5 @@ def run_matrix(
         "seed": seed,
         "deterministic": deterministic,
         "matrix": matrix,
-        "eval_compiles": guard.count,
+        "eval_compiles": program.compile_count,
     }

@@ -45,6 +45,7 @@ from pathlib import Path
 from typing import Any, Deque, Optional, Tuple
 
 from marl_distributedformation_tpu.utils.checkpoint import (
+    CheckpointDiscovery,
     checkpoint_step,
     latest_checkpoint,
     restore_state_dict_partial,
@@ -161,6 +162,11 @@ class FleetReloadCoordinator:
         self.load_errors: Deque[Tuple[str, str]] = deque(
             maxlen=max_recorded_errors
         )
+        # Incremental discovery: a long-running watcher polls this
+        # directory forever, and re-listing + re-parsing every historic
+        # checkpoint each poll degrades O(total checkpoints). Same
+        # discovery contract as latest_checkpoint (utils.checkpoint).
+        self._discovery = CheckpointDiscovery(self.log_dir)
         # The fleet step starts at the newest step any replica already
         # serves (the router seeds every replica identically).
         self._fleet_step = max(
@@ -182,65 +188,96 @@ class FleetReloadCoordinator:
         checkpoint landed. Returns True on swap. Load failures keep the
         old params serving fleet-wide and are recorded."""
         with self._refresh_lock:
-            path = latest_checkpoint(self.log_dir)
+            path = self._discovery.latest()
             if path is None:
                 return False
             step = checkpoint_step(path)
             if step <= self._fleet_step:
                 return False
+            return self._load_and_commit(path, step)
+
+    def reload_pinned(self, path: str | Path, monotonic: bool = True) -> bool:
+        """Coordinated swap of an EXPLICIT checkpoint path, bypassing
+        directory discovery. ``monotonic=False`` is the DEMOTION hook
+        (pipeline/rollback): the swap is exempt from the never-go-
+        backward rule, so a rollback to the last-good checkpoint is just
+        a pinned reload at the same fleet batch barrier — responses
+        after the commit legitimately carry the older step, and the
+        caller owns retracting the demoted checkpoint from the watched
+        directory (otherwise the next poll would re-promote it). With
+        ``monotonic=True`` this is a targeted forward swap with the
+        usual old-steps-ignored semantics. Same containment contract as
+        :meth:`refresh`: a bad file is a recorded ``load_errors`` entry
+        and the fleet keeps serving what it serves."""
+        path = Path(path)
+        with self._refresh_lock:
             try:
-                restored = self._load_validated(path)
-            except Exception as e:  # noqa: BLE001 — serving must not die
+                step = checkpoint_step(path)
+            except ValueError as e:
                 self.load_errors.append((str(path), repr(e)))
                 return False
-            import jax
+            if monotonic and step <= self._fleet_step:
+                return False
+            if step == self._fleet_step:
+                return False  # already serving exactly this step
+            return self._load_and_commit(path, step)
 
-            # Prepare: one host->device upload per replica, all before
-            # the barrier — the commit window stays lock-acquisition
-            # plus pointer flips, never a weight transfer.
-            staged = [
-                (r, jax.device_put(restored, r.registry.device))
-                for r in self.router.replicas
-            ]
-            barriers = [r.registry.batch_lock for r, _ in staged]
-            held = []
-            try:
-                # Close every gate FIRST: workers finish their current
-                # batch and park instead of re-contending their lock, so
-                # the acquisitions below complete within one in-flight
-                # batch (BatchBarrier's fairness note). Workers only
-                # ever hold their own lock — no cycle to deadlock on.
-                # With all locks held, zero batches are in flight
-                # fleet-wide: the commit point. The per-barrier timeout
-                # bounds a wedged replica (hung device op holding its
-                # lock): abort the WHOLE commit rather than park the
-                # fleet or swap partially — the finally reopens every
-                # gate and the old step keeps serving everywhere.
-                for b in barriers:
-                    b.close()
-                for i, b in enumerate(barriers):
-                    if not b.acquire(timeout=self.commit_timeout_s):
-                        self.load_errors.append(
-                            (
-                                str(path),
-                                f"commit aborted: replica {i} barrier "
-                                f"not acquired in {self.commit_timeout_s}"
-                                "s (wedged dispatch?); old step keeps "
-                                "serving fleet-wide",
-                            )
+    def _load_and_commit(self, path: Path, step: int) -> bool:
+        """Restore + validate once, then commit fleet-wide at the batch
+        barrier. Caller holds ``_refresh_lock``."""
+        try:
+            restored = self._load_validated(path)
+        except Exception as e:  # noqa: BLE001 — serving must not die
+            self.load_errors.append((str(path), repr(e)))
+            return False
+        import jax
+
+        # Prepare: one host->device upload per replica, all before
+        # the barrier — the commit window stays lock-acquisition
+        # plus pointer flips, never a weight transfer.
+        staged = [
+            (r, jax.device_put(restored, r.registry.device))
+            for r in self.router.replicas
+        ]
+        barriers = [r.registry.batch_lock for r, _ in staged]
+        held = []
+        try:
+            # Close every gate FIRST: workers finish their current
+            # batch and park instead of re-contending their lock, so
+            # the acquisitions below complete within one in-flight
+            # batch (BatchBarrier's fairness note). Workers only
+            # ever hold their own lock — no cycle to deadlock on.
+            # With all locks held, zero batches are in flight
+            # fleet-wide: the commit point. The per-barrier timeout
+            # bounds a wedged replica (hung device op holding its
+            # lock): abort the WHOLE commit rather than park the
+            # fleet or swap partially — the finally reopens every
+            # gate and the old step keeps serving everywhere.
+            for b in barriers:
+                b.close()
+            for i, b in enumerate(barriers):
+                if not b.acquire(timeout=self.commit_timeout_s):
+                    self.load_errors.append(
+                        (
+                            str(path),
+                            f"commit aborted: replica {i} barrier "
+                            f"not acquired in {self.commit_timeout_s}"
+                            "s (wedged dispatch?); old step keeps "
+                            "serving fleet-wide",
                         )
-                        return False
-                    held.append(b)
-                for r, params in staged:
-                    r.registry.install(params, step)
-                self._fleet_step = step
-                self.swap_count += 1
-            finally:
-                for b in reversed(held):
-                    b.release()
-                for b in barriers:
-                    b.open()
-            return True
+                    )
+                    return False
+                held.append(b)
+            for r, params in staged:
+                r.registry.install(params, step)
+            self._fleet_step = step
+            self.swap_count += 1
+        finally:
+            for b in reversed(held):
+                b.release()
+            for b in barriers:
+                b.open()
+        return True
 
     def _load_validated(self, path: Path) -> Any:
         """One restore + validation for the whole fleet, against replica

@@ -499,6 +499,12 @@ class Trainer:
         self.log_dir = config.log_dir or str(
             repo_root() / "logs" / config.name
         )
+        # Optional checkpoint-durability hook (the always-learning
+        # pipeline sets it to nudge its CheckpointStream): called with
+        # the path AFTER the atomic rename lands — for async writes that
+        # is on the writer thread, when the file is discoverable, not at
+        # submit time (the bytes are still in flight then).
+        self.on_checkpoint: Optional[Any] = None
 
         if config.resume:
             self._try_resume()
@@ -797,7 +803,11 @@ class Trainer:
         snapshot to the writer thread, which ``device_get``s and writes
         atomically while the device keeps training."""
         path = checkpoint_path(self.log_dir, self.num_timesteps)
-        writer.submit(path, device_snapshot(self._checkpoint_target()))
+        writer.submit(
+            path,
+            device_snapshot(self._checkpoint_target()),
+            on_done=self.on_checkpoint,
+        )
         self._vec_steps_since_save = 0
         return str(path)
 
@@ -963,6 +973,8 @@ class Trainer:
             self.log_dir, self.num_timesteps, self._checkpoint_target()
         )
         self._vec_steps_since_save = 0
+        if path is not None and self.on_checkpoint is not None:
+            self.on_checkpoint(path)
         return str(path) if path is not None else None
 
     def _learner_template(self) -> Dict[str, Any]:

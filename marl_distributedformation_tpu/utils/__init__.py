@@ -12,6 +12,7 @@ from marl_distributedformation_tpu.utils.config import (  # noqa: F401
 )
 from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
     AsyncCheckpointWriter,
+    CheckpointDiscovery,
     broadcast_restore,
     checkpoint_path,
     checkpoint_step,

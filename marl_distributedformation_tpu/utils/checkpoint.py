@@ -14,10 +14,12 @@ file — host-side, TPU-independent, and restorable on any backend.
 
 from __future__ import annotations
 
+import os
 import re
 import threading
+import time
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Dict, List, Optional
 
 from flax import serialization
 
@@ -155,12 +157,26 @@ class AsyncCheckpointWriter:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
-    def submit(self, path: str | Path, target: Any) -> Path:
+    def submit(
+        self, path: str | Path, target: Any, on_done: Any = None
+    ) -> Path:
         """Queue one atomic write of ``target`` to ``path``. ``target``
         must already be safe to read from another thread (host arrays, or
-        a :func:`device_snapshot` the caller's donation cannot touch)."""
+        a :func:`device_snapshot` the caller's donation cannot touch).
+        ``on_done(path)``, if given, runs on the writer thread AFTER the
+        rename lands — i.e. when the file is durably discoverable. The
+        always-learning pipeline uses it to nudge its checkpoint stream
+        the moment a candidate exists instead of waiting out a poll
+        interval; a hook failure surfaces like a write failure (next
+        submit/close), never silently."""
         path = Path(path)
-        self.submit_write(lambda: _write_atomic(path, target))
+
+        def write() -> None:
+            _write_atomic(path, target)
+            if on_done is not None:
+                on_done(path)
+
+        self.submit_write(write)
         return path
 
     def submit_write(self, write_fn: Any) -> None:
@@ -256,6 +272,106 @@ def latest_checkpoint(log_dir: str | Path) -> Optional[Path]:
     """Find the checkpoint with the largest step number, exactly like the
     reference's discovery scan (visualize_policy.py:29-32)."""
     return _latest(log_dir, _STEP_RE)
+
+
+class CheckpointDiscovery:
+    """Incremental ``rl_model_*`` discovery for long-running watchers.
+
+    ``latest_checkpoint`` re-lists and re-regexes the WHOLE directory on
+    every call — fine for a one-shot CLI, but an always-learning run
+    polls its trainer directory for hours while the checkpoint count
+    grows without bound, so each poll would degrade O(total
+    checkpoints). This class keeps the same discovery contract (same
+    filename filter, same step parse, torn ``.tmp`` files invisible —
+    pinned by tests/test_pipeline.py) while bounding steady-state polls:
+
+    - Filenames are parsed ONCE: a name→step cache means a re-listing
+      only regexes names it has never seen.
+    - Idle polls are one ``stat``: the directory's mtime changes
+      whenever an entry is added/renamed into it, so an unchanged mtime
+      means an unchanged listing. Because mtime granularity is finite,
+      the skip is only trusted when the previous listing happened
+      comfortably AFTER the recorded mtime (``_MTIME_SLACK_S``) — a
+      file landing in the same mtime tick as a listing can therefore
+      never be missed, only discovered one listing later.
+
+    ``latest()`` is the non-consuming view (what the fleet coordinator
+    polls); ``poll_new()`` is the consuming stream (ascending step
+    order, each checkpoint yielded exactly once) the promotion pipeline
+    tails. New steps at or below the consumed high-water mark are
+    ignored by ``poll_new`` — the same never-go-backward semantics the
+    serving registry applies to ``latest_checkpoint``.
+    """
+
+    _MTIME_SLACK_S = 2.0
+
+    def __init__(
+        self, log_dir: str | Path, start_after_step: int = -1
+    ) -> None:
+        self.log_dir = Path(log_dir)
+        self._known: Dict[str, int] = {}  # filename -> parsed step
+        self._high_water = int(start_after_step)
+        self._dir_mtime_ns: Optional[int] = None
+        self._listing_stable = False  # last listing postdated the mtime
+
+    def _refresh(self) -> None:
+        try:
+            st = os.stat(self.log_dir)
+        except OSError:  # directory not created yet
+            self._dir_mtime_ns = None
+            self._listing_stable = False
+            return
+        if (
+            self._listing_stable
+            and st.st_mtime_ns == self._dir_mtime_ns
+        ):
+            return  # idle poll: one stat, no listing, no parsing
+        now = time.time()
+        with os.scandir(self.log_dir) as entries:
+            for entry in entries:
+                name = entry.name
+                if name in self._known or not name.endswith(".msgpack"):
+                    continue
+                m = _STEP_RE.search(name)
+                if m is None:
+                    continue
+                self._known[name] = int(m.group(1))
+        self._dir_mtime_ns = st.st_mtime_ns
+        # Trust future mtime-equality skips only if this listing ran
+        # strictly after the mtime tick it recorded — otherwise a file
+        # created within the same tick could hide behind an "unchanged"
+        # mtime forever.
+        self._listing_stable = (now - st.st_mtime) > self._MTIME_SLACK_S
+
+    def latest(self) -> Optional[Path]:
+        """Newest checkpoint path — ``latest_checkpoint`` semantics,
+        incremental cost. Deleted entries (the pipeline's rollback
+        RETRACTS demoted checkpoints) are dropped from the cache on
+        discovery, so ``latest`` can step back down to an older file."""
+        self._refresh()
+        while self._known:
+            name = max(self._known, key=self._known.__getitem__)
+            path = self.log_dir / name
+            if path.exists():
+                return path
+            del self._known[name]
+        return None
+
+    def poll_new(self) -> List[Path]:
+        """Checkpoints discovered above the consumed high-water mark, in
+        ascending step order; advances the mark past everything
+        returned."""
+        self._refresh()
+        fresh = sorted(
+            (
+                (step, name)
+                for name, step in self._known.items()
+                if step > self._high_water
+            ),
+        )
+        if fresh:
+            self._high_water = fresh[-1][0]
+        return [self.log_dir / name for _, name in fresh]
 
 
 def restore_checkpoint(path: str | Path, template: Any) -> Any:

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""One process, the whole product: trainer -> gate -> fleet, always learning.
+
+Runs the supervised continuous-learning loop (``pipeline/``,
+docs/pipeline.md) end to end: a Trainer streams checkpoints into
+``logs/{name}/``, every candidate is judged by the PromotionGate (the
+compiled robustness matrix + clean-return regression vs the served
+baseline — ONE jitted eval program across all candidates, budget-1
+RetraceGuard receipt), passing candidates are published to
+``logs/{name}/promoted/`` and hot-swapped into a multi-replica serving
+fleet at the batch barrier (globally step-monotonic ``model_step``),
+and an optional RollbackMonitor demotes to last-good on a served-metric
+regression. Verdicts land in ``logs/{name}/promotions.jsonl``.
+
+Usage (same key=value CLI as every entry point; trainer keys ride
+through to ``train.build_trainer``):
+
+    python scripts/always_learning.py name=always num_formation=64 \\
+        total_timesteps=64000 max_steps=100 pipeline_replicas=2
+
+    # what bench.py phase 7 measures (forced 2-device CPU, tiny run):
+    JAX_PLATFORMS=cpu python scripts/always_learning.py name=bench_pipeline \\
+        num_formation=16 total_timesteps=4800 max_steps=60 \\
+        gate_formations=32 pipeline_replicas=2
+
+Prints exactly one JSON line: promotions / rejections / rollbacks,
+``promotion_latency_s_p50``/``p95`` (train-step -> served model_step
+wall time), ``gate_eval_steps_per_sec``, the compile-once receipts, and
+the final served step.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from marl_distributedformation_tpu.utils import (  # noqa: E402
+    env_params_from_config,
+    load_config,
+    setup_platform,
+    validate_override_keys,
+)
+
+PIPELINE_KEYS = (
+    # gate
+    "gate_scenarios",
+    "gate_severities",
+    "gate_formations",
+    "gate_seed",
+    "gate_clean_tolerance",
+    "gate_rung_tolerance",
+    # fleet
+    "pipeline_replicas",
+    "pipeline_buckets",
+    "pipeline_port",
+    "pipeline_poll_s",
+    "pipeline_budget_s",
+    "pipeline_verify_requests",
+    # rollback
+    "rollback_metric",
+    "rollback_threshold",
+    "rollback_ratio",
+    "rollback_direction",
+    "rollback_trip_after",
+    "rollback_baseline_samples",
+    "out",
+)
+# Trainer knobs are the normal YAML config surface (train.py is
+# struct-less); this entry point validates only because a mistyped
+# pipeline key would otherwise silently run the defaults.
+TRAIN_EXTRA_KEYS = (
+    "save_freq", "policy", "hidden_sizes", "mesh", "num_seeds",
+    "curriculum", "learning_rates", "platform", "preset", "fused_chunk",
+    "iters_per_dispatch", "guard_retraces", "guard_transfers",
+    "guard_nans", "profile", "profile_iterations",
+)
+
+
+def _gate_config(cfg):
+    from marl_distributedformation_tpu.pipeline import GateConfig
+
+    scenarios = cfg.get("gate_scenarios") or ["wind", "sensor_noise"]
+    if not isinstance(scenarios, list):
+        scenarios = [scenarios]
+    severities = cfg.get("gate_severities") or [0.5, 1.0]
+    if not isinstance(severities, list):
+        severities = [severities]
+    return GateConfig(
+        scenarios=tuple(str(s) for s in scenarios),
+        severities=tuple(float(s) for s in severities),
+        eval_formations=int(cfg.get("gate_formations", 64)),
+        eval_seed=int(cfg.get("gate_seed", 1234)),
+        clean_tolerance=float(cfg.get("gate_clean_tolerance", 0.05)),
+        rung_tolerance=float(cfg.get("gate_rung_tolerance", 0.10)),
+    )
+
+
+def _monitor(cfg, router):
+    metric = cfg.get("rollback_metric")
+    if not metric:
+        return None
+    from marl_distributedformation_tpu.pipeline import RollbackMonitor
+
+    return RollbackMonitor(
+        router.snapshot,
+        metric=str(metric),
+        threshold=cfg.get("rollback_threshold"),
+        ratio=cfg.get("rollback_ratio"),
+        direction=str(cfg.get("rollback_direction") or "above"),
+        baseline_samples=int(cfg.get("rollback_baseline_samples", 3)),
+        trip_after=int(cfg.get("rollback_trip_after", 2)),
+    )
+
+
+def main(argv=None) -> dict:
+    overrides = sys.argv[1:] if argv is None else argv
+    validate_override_keys(
+        overrides, extra_keys=PIPELINE_KEYS + TRAIN_EXTRA_KEYS
+    )
+    cfg = load_config(overrides)
+    setup_platform(cfg.get("platform"))
+
+    replicas = int(cfg.get("pipeline_replicas", 2))
+    import jax
+
+    if jax.default_backend() == "cpu" and len(jax.local_devices()) < replicas:
+        # The forced multi-device CPU mesh (the dev/bench shape): widen
+        # the device pool so each serving replica gets a real device.
+        from serve_policy import _ensure_cpu_devices
+
+        _ensure_cpu_devices(replicas)
+
+    import train as train_entry
+    from marl_distributedformation_tpu.pipeline import (
+        AlwaysLearningPipeline,
+    )
+    from marl_distributedformation_tpu.train import Trainer
+
+    env_params = env_params_from_config(cfg)
+    trainer = train_entry.build_trainer(cfg)
+    if not isinstance(trainer, Trainer):
+        raise SystemExit(
+            "the always-learning pipeline drives the single-run Trainer; "
+            "population sweeps / curriculum trainers checkpoint a "
+            "different layout (drop num_seeds / curriculum)"
+        )
+
+    budget_s = float(cfg.get("pipeline_budget_s", 600.0))
+    deadline = time.time() + budget_s
+    pipeline = AlwaysLearningPipeline(
+        trainer.log_dir,
+        env_params,
+        gate_config=_gate_config(cfg),
+        poll_interval_s=float(cfg.get("pipeline_poll_s", 0.25)),
+    )
+    pipeline.attach_trainer(trainer)
+
+    train_error: list = []
+
+    def run_training() -> None:
+        try:
+            trainer.train()
+        except BaseException as e:  # noqa: BLE001 — surfaced in the report
+            train_error.append(repr(e))
+
+    train_thread = threading.Thread(
+        target=run_training, name="always-learning-trainer", daemon=True
+    )
+    print(
+        f"[always] {cfg.name}: training M={cfg.num_formation} to "
+        f"{trainer.total_timesteps} agent-transitions; gate "
+        f"{pipeline.gate.config.scenarios} x "
+        f"{pipeline.gate.config.severities}; fleet {replicas} replicas",
+        file=sys.stderr,
+    )
+    train_thread.start()
+
+    report: dict = {"name": str(cfg.name)}
+    router = None
+    frontend = None
+    try:
+        if not pipeline.wait_first_promotion(
+            timeout_s=max(deadline - time.time(), 1.0)
+        ):
+            raise SystemExit(
+                "no candidate passed the gate within pipeline_budget_s "
+                f"({budget_s:g}s) — see logs/{cfg.name}/promotions.jsonl"
+            )
+
+        from marl_distributedformation_tpu.serving.fleet import (
+            fleet_from_checkpoint_dir,
+            warmup_fleet,
+        )
+
+        buckets = cfg.get("pipeline_buckets") or [1, 8]
+        router, coordinator = fleet_from_checkpoint_dir(
+            pipeline.promoted_dir,
+            env_params=env_params,
+            act_dim=env_params.act_dim,
+            num_replicas=replicas,
+            buckets=tuple(int(b) for b in buckets),
+        )
+        router.start()
+        warmup_fleet(router, (env_params.obs_dim,))
+        port = cfg.get("pipeline_port")
+        if port is not None:
+            from marl_distributedformation_tpu.serving.fleet import (
+                FleetFrontend,
+            )
+
+            frontend = FleetFrontend(router, port=int(port)).start()
+            report["frontend_url"] = frontend.url
+            print(f"[always] frontend: {frontend.url}", file=sys.stderr)
+        pipeline.attach_fleet(router, coordinator)
+        monitor = _monitor(cfg, router)
+        if monitor is not None:
+            pipeline.attach_monitor(monitor)
+
+        # Supervision loop: drain candidates while the trainer runs,
+        # then drain the tail after it finishes.
+        while time.time() < deadline:
+            processed = pipeline.poll_once()
+            if not train_thread.is_alive() and processed == 0:
+                # The trainer may have written its final checkpoint
+                # between our poll and the liveness check (train()
+                # returning guarantees the async writer drained) — one
+                # post-death drain closes the race.
+                if pipeline.poll_once() == 0:
+                    break
+                continue
+            if processed == 0:
+                time.sleep(0.05)
+        train_thread.join(timeout=max(deadline - time.time(), 0.0))
+
+        # Verification traffic: the served step must be the promoted one.
+        import numpy as np
+
+        n_verify = int(cfg.get("pipeline_verify_requests", 4))
+        served_steps = []
+        rng = np.random.default_rng(0)
+        for _ in range(n_verify):
+            obs = rng.standard_normal(
+                (2, env_params.obs_dim), dtype=np.float32
+            )
+            res = router.submit(obs).result(timeout=30.0)
+            served_steps.append(int(res.model_step))
+
+        report.update(pipeline.summary())
+        report["pipeline_replicas"] = replicas
+        report["fleet_swap_count"] = coordinator.swap_count
+        report["verified_served_steps"] = served_steps
+        report["train_alive"] = train_thread.is_alive()
+        if train_error:
+            report["train_error"] = train_error[0][:300]
+        compile_receipts = router.compile_counts()
+        report["serving_max_compiles_per_rung"] = max(
+            (
+                c
+                for per in compile_receipts.values()
+                for c in per.values()
+            ),
+            default=0,
+        )
+    finally:
+        if frontend is not None:
+            frontend.stop()
+        if router is not None:
+            router.stop()
+        pipeline.stop()
+
+    out = cfg.get("out")
+    if out:
+        Path(out).parent.mkdir(parents=True, exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,58 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 from mirror_bench import _load_record as load_record  # noqa: E402
 
 
+def _pipeline_problems(rec: dict) -> list[str]:
+    """Structural validation of the always-learning pipeline fields
+    (bench phase 7): whenever a record carries them, they must be
+    internally consistent — a latency percentile pair that is not a
+    percentile pair, or a gate that compiled more than once, is a
+    malformed record regardless of which stage required the fields."""
+    problems = []
+    p50 = rec.get("promotion_latency_s_p50")
+    p95 = rec.get("promotion_latency_s_p95")
+    if (p50 is None) != (p95 is None):
+        problems.append(
+            "promotion_latency_s_p50/p95 must be recorded together"
+        )
+    if p50 is not None and p95 is not None:
+        try:
+            p50, p95 = float(p50), float(p95)
+            if not 0.0 < p50 <= p95:
+                problems.append(
+                    f"promotion latency percentiles malformed: "
+                    f"p50={p50} p95={p95} (need 0 < p50 <= p95)"
+                )
+        except (TypeError, ValueError):
+            problems.append("promotion latency fields are not numbers")
+        gate = rec.get("gate_eval_steps_per_sec")
+        try:
+            gate_ok = gate is not None and float(gate) > 0.0
+        except (TypeError, ValueError):
+            gate_ok = False
+        if not gate_ok:
+            problems.append(
+                f"gate_eval_steps_per_sec missing/zero/non-numeric "
+                f"beside promotion latency: {gate!r}"
+            )
+        compiles = rec.get("pipeline_gate_compiles")
+        if compiles != 1:
+            problems.append(
+                f"pipeline_gate_compiles={compiles!r} — the gate's eval "
+                "program must compile exactly once across all candidates"
+            )
+        rung = rec.get("pipeline_serving_max_compiles_per_rung")
+        try:
+            rung_ok = rung is None or int(rung) <= 1
+        except (TypeError, ValueError):
+            rung_ok = False
+        if not rung_ok:
+            problems.append(
+                f"pipeline_serving_max_compiles_per_rung={rung!r} "
+                "(need an int <= 1)"
+            )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -39,6 +91,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     notes = str(rec.get("notes", ""))
     if "skipped" in notes or "failed" in notes:
         problems.append(f"degraded phases in notes: {notes!r}")
+    problems.extend(_pipeline_problems(rec))
     for field in require:
         try:
             ok = float(rec.get(field, 0.0)) > 0.0

@@ -8,6 +8,13 @@ severities, on identical initial states, in ONE compiled eval program
 contract is enforced with a budget-1 RetraceGuard and the compile count
 is recorded in the report).
 
+This CLI is a thin wrapper: the compiled program lives in
+``scenarios.matrix`` (``run_matrix`` for a one-shot checkpoint sweep,
+``MatrixProgram`` for a long-lived reusable instance) — the
+always-learning promotion gate (``pipeline/gate.py``) holds ONE
+MatrixProgram for an entire run instead of shelling out here or
+re-jitting per candidate.
+
 Usage (same key=value CLI as every entry point):
     python scripts/robustness_matrix.py name=myrun
     python scripts/robustness_matrix.py name=myrun scenarios=[wind,storm] \

@@ -30,6 +30,20 @@ from marl_distributedformation_tpu.analysis.rules import rule_names  # noqa: E40
 
 
 def lint(src):
+    """Lint a fixture. A plain string is one in-memory module; a dict
+    ``{filename: source}`` is a multi-file fixture written to a real
+    temp directory (cross-module rules resolve imports on disk) with
+    ``main.py`` as the linted module."""
+    if isinstance(src, dict):
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            d = Path(td)
+            for name, content in src.items():
+                (d / name).write_text(textwrap.dedent(content))
+            return lint_source(
+                textwrap.dedent(src["main.py"]), str(d / "main.py")
+            )
     return lint_source(textwrap.dedent(src), "fixture.py")
 
 
@@ -606,6 +620,106 @@ FIXTURES = [
                 return (p, a + x), a
             return lax.scan(body, (p0, acc), xs)
         """,
+    ),
+    (
+        # Cross-module reachability: the callback hides one `from x
+        # import f` away — invisible to rule 12's same-module hop.
+        "cross-module-callback",
+        {
+            "main.py": """
+            import jax
+            from jax import lax
+            from telemetry import emit
+
+            def train(xs):
+                def body(carry, x):
+                    emit(x)  # io_callback lives in telemetry.py
+                    return carry + x, x
+                return lax.scan(body, 0.0, xs)
+            """,
+            "telemetry.py": """
+            import jax
+
+            def emit(metrics):
+                jax.experimental.io_callback(print, None, metrics)
+            """,
+        },
+        {
+            "main.py": """
+            import jax
+            from jax import lax
+            from telemetry import emit, fold
+
+            def train(xs):
+                def body(carry, x):
+                    return fold(carry, x), x  # imported but pure: clean
+                carry, stacked = lax.scan(body, 0.0, xs)
+                emit(stacked)  # outside the loop: once per chunk, fine
+                return carry, stacked
+            """,
+            "telemetry.py": """
+            import jax
+
+            def emit(metrics):
+                jax.experimental.io_callback(print, None, metrics)
+
+            def fold(carry, x):
+                return carry + x
+            """,
+        },
+    ),
+    (
+        # Same hazard via a module alias (`import pkg_mod as telem;
+        # telem.emit(...)`) inside a fori_loop body.
+        "cross-module-callback",
+        {
+            "main.py": """
+            import jax
+            from jax import lax
+            import telem
+
+            def train(steps, state):
+                def body(i, state):
+                    telem.emit(state)  # reaches jax.debug.callback
+                    return state
+                return lax.fori_loop(0, steps, body, state)
+            """,
+            "telem.py": """
+            import jax
+
+            def emit(state):
+                jax.debug.callback(print, state)
+            """,
+        },
+        {
+            "main.py": """
+            import jax
+            from jax import lax
+            import telem
+
+            def emit(state):
+                # same-module def SHADOWS the import target name space:
+                # plain `emit(...)` here is rule 12's domain, not ours
+                return state
+
+            def train(steps, state):
+                def body(i, state):
+                    emit(state)  # resolves to the local, clean def
+                    return telem.scale(state)  # imported but pure
+                state = lax.fori_loop(0, steps, body, state)
+                telem.emit(state)  # outside the loop: fine
+                return state
+            """,
+            "telem.py": """
+            import jax
+
+            def emit(state):
+                jax.debug.callback(print, state)
+
+            def scale(state):
+                return state * 2
+            """,
+        },
     ),
 ]
 

@@ -1,0 +1,637 @@
+"""Always-learning pipeline contract (tier-1, multi-device CPU).
+
+The acceptance pins from the pipeline ISSUE:
+
+- incremental checkpoint discovery preserves the classic contract
+  (step-order yield, torn ``.tmp`` files invisible, ``latest`` ==
+  ``latest_checkpoint``) while idle polls skip the directory listing;
+- the gate's verdict logic rejects non-finite / clean-regressed /
+  rung-regressed candidates and bootstraps cleanly (pure-function unit
+  tests — no eval needed);
+- ``promotions.jsonl`` lines carry the versioned schema;
+- the rollback monitor needs a sustained breach, not one noisy sample;
+- ``reload_pinned(monotonic=False)`` is a real coordinated demotion;
+- END TO END on the conftest 8-device CPU mesh: a trainer's checkpoint
+  series with one sabotaged (NaN params) candidate — the sabotaged step
+  is provably never served, passing candidates serve step-monotonically,
+  a forced serving-metric regression rolls the fleet back to last-good,
+  and the gate's eval program compiles EXACTLY once across every
+  candidate (budget-1 receipt in the verdict log).
+"""
+
+import json
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+from flax import serialization
+
+from marl_distributedformation_tpu.algo import PPOConfig
+from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.pipeline import (
+    AlwaysLearningPipeline,
+    CheckpointStream,
+    GateConfig,
+    PromotionLog,
+    RollbackMonitor,
+    judge_candidate,
+)
+from marl_distributedformation_tpu.pipeline.promote import PROMOTIONS_SCHEMA
+from marl_distributedformation_tpu.serving.fleet import (
+    fleet_from_checkpoint_dir,
+    warmup_fleet,
+)
+from marl_distributedformation_tpu.train import TrainConfig, Trainer
+from marl_distributedformation_tpu.utils.checkpoint import (
+    CheckpointDiscovery,
+    _write_atomic,
+    checkpoint_path,
+    checkpoint_step,
+    latest_checkpoint,
+)
+
+ENV = EnvParams(num_agents=3, max_steps=20)
+
+
+# ---------------------------------------------------------------------------
+# Incremental discovery (utils.checkpoint.CheckpointDiscovery)
+# ---------------------------------------------------------------------------
+
+
+def _touch_ckpt(log_dir, step):
+    path = checkpoint_path(log_dir, step)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_bytes(b"x")
+    return path
+
+
+def test_discovery_order_and_torn_write_invisibility(tmp_path):
+    """Same contract as latest_checkpoint: step order regardless of
+    creation order, dot-prefixed .tmp files never observed."""
+    for step in (5, 30, 10):  # scrambled creation order
+        _touch_ckpt(tmp_path, step)
+    (tmp_path / ".rl_model_999_steps.msgpack.tmp").write_bytes(b"torn")
+    (tmp_path / "notes.txt").write_text("not a checkpoint")
+    disco = CheckpointDiscovery(tmp_path)
+    assert [checkpoint_step(p) for p in disco.poll_new()] == [5, 10, 30]
+    assert disco.latest() == latest_checkpoint(tmp_path)
+    # New higher step appears incrementally…
+    _touch_ckpt(tmp_path, 40)
+    assert [checkpoint_step(p) for p in disco.poll_new()] == [40]
+    # …while a LOWER step landing later is ignored by the consuming
+    # stream (never-go-backward) and by latest().
+    _touch_ckpt(tmp_path, 20)
+    assert disco.poll_new() == []
+    assert checkpoint_step(disco.latest()) == 40
+
+
+def test_discovery_idle_polls_skip_listing(tmp_path, monkeypatch):
+    """Steady-state polls of an unchanged directory must be one stat —
+    no O(total checkpoints) re-list/re-parse (the always-learning
+    degradation this path exists to avoid)."""
+    _touch_ckpt(tmp_path, 10)
+    monkeypatch.setattr(CheckpointDiscovery, "_MTIME_SLACK_S", 0.0)
+    calls = []
+    real_scandir = os.scandir
+
+    def counting_scandir(path):
+        calls.append(str(path))
+        return real_scandir(path)
+
+    monkeypatch.setattr(os, "scandir", counting_scandir)
+    disco = CheckpointDiscovery(tmp_path)
+    assert [checkpoint_step(p) for p in disco.poll_new()] == [10]
+    listed = len(calls)
+    assert listed >= 1
+    for _ in range(5):  # idle polls: no listing
+        assert disco.poll_new() == []
+        assert checkpoint_step(disco.latest()) == 10
+    assert len(calls) == listed
+    # A new checkpoint bumps the dir mtime -> exactly the next poll
+    # re-lists and finds it.
+    _touch_ckpt(tmp_path, 20)
+    assert [checkpoint_step(p) for p in disco.poll_new()] == [20]
+    assert len(calls) > listed
+
+
+def test_discovery_latest_survives_retraction(tmp_path):
+    """Rollback deletes promoted checkpoints: latest() must step back
+    down to the surviving newest file instead of returning a ghost."""
+    _touch_ckpt(tmp_path, 10)
+    p20 = _touch_ckpt(tmp_path, 20)
+    disco = CheckpointDiscovery(tmp_path)
+    assert checkpoint_step(disco.latest()) == 20
+    p20.unlink()
+    assert checkpoint_step(disco.latest()) == 10
+
+
+def test_stream_yields_each_checkpoint_once(tmp_path):
+    stream = CheckpointStream(tmp_path, poll_interval_s=0.01)
+    assert stream.wait(0.05) == []
+    _touch_ckpt(tmp_path, 7)
+    stream.nudge()
+    got = stream.wait(5.0)
+    assert [checkpoint_step(p) for p in got] == [7]
+    assert stream.poll() == []
+
+
+# ---------------------------------------------------------------------------
+# Gate verdict logic (pure) + promotions.jsonl schema
+# ---------------------------------------------------------------------------
+
+METRIC = "episode_return_per_agent"
+
+
+def _cells(value):
+    return {"wind": {"1": {METRIC: value}}}
+
+
+def test_judge_bootstrap_and_pass():
+    # No baseline: any finite candidate bootstraps.
+    assert judge_candidate(
+        METRIC, {METRIC: 100.0}, _cells(50.0), None, None, 0.05, 0.10
+    ) == []
+    # Matching-or-better candidate passes against a baseline.
+    assert judge_candidate(
+        METRIC, {METRIC: 101.0}, _cells(55.0),
+        {METRIC: 100.0}, _cells(50.0), 0.05, 0.10,
+    ) == []
+
+
+def test_judge_rejects_clean_regression():
+    reasons = judge_candidate(
+        METRIC, {METRIC: 80.0}, _cells(50.0),
+        {METRIC: 100.0}, _cells(50.0), 0.05, 0.10,
+    )
+    assert len(reasons) == 1 and "clean" in reasons[0]
+
+
+def test_judge_rejects_severity_rung_regression():
+    reasons = judge_candidate(
+        METRIC, {METRIC: 100.0}, _cells(30.0),
+        {METRIC: 100.0}, _cells(50.0), 0.05, 0.10,
+    )
+    assert len(reasons) == 1 and "severity rung wind@1" in reasons[0]
+
+
+def test_judge_rejects_non_finite_even_at_bootstrap():
+    reasons = judge_candidate(
+        METRIC, {METRIC: math.nan}, _cells(50.0), None, None, 0.05, 0.10
+    )
+    assert len(reasons) == 1 and "non-finite" in reasons[0]
+    # NaN in a rung cell is caught too, and short-circuits.
+    reasons = judge_candidate(
+        METRIC, {METRIC: 10.0}, _cells(math.inf),
+        {METRIC: 100.0}, _cells(50.0), 0.05, 0.10,
+    )
+    assert len(reasons) == 1 and "non-finite" in reasons[0]
+
+
+def test_judge_missing_baseline_cell_is_not_a_regression():
+    assert judge_candidate(
+        METRIC, {METRIC: 100.0},
+        {"storm": {"1": {METRIC: 1.0}}},  # baseline never saw storm
+        {METRIC: 100.0}, _cells(50.0), 0.05, 0.10,
+    ) == []
+
+
+def test_promotion_log_schema(tmp_path):
+    log = PromotionLog(tmp_path / "promotions.jsonl")
+    log.append("rejected", step=10, checkpoint="x", reasons=["bad"])
+    log.append("promoted", step=20, checkpoint="y", reasons=[])
+    records = PromotionLog.read(tmp_path / "promotions.jsonl")
+    assert [r["event"] for r in records] == ["rejected", "promoted"]
+    for r in records:
+        assert r["schema"] == PROMOTIONS_SCHEMA
+        assert isinstance(r["time"], float)
+        assert isinstance(r["step"], int)
+    # Append-only JSONL: every line independently parseable.
+    lines = (tmp_path / "promotions.jsonl").read_text().splitlines()
+    assert all(json.loads(ln) for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# Rollback monitor
+# ---------------------------------------------------------------------------
+
+
+def test_rollback_monitor_ratio_needs_sustained_breach():
+    values = {"latency_p95_ms": 10.0}
+    monitor = RollbackMonitor(
+        lambda: values, "latency_p95_ms", ratio=2.0,
+        baseline_samples=2, trip_after=2,
+    )
+    assert not monitor.observe()  # baseline sample 1
+    assert not monitor.observe()  # baseline sample 2 -> baseline 10
+    assert monitor.baseline == 10.0
+    values["latency_p95_ms"] = 50.0
+    assert not monitor.observe()  # breach 1 of 2
+    values["latency_p95_ms"] = 11.0
+    assert not monitor.observe()  # recovered: streak resets
+    values["latency_p95_ms"] = 50.0
+    assert not monitor.observe()
+    assert monitor.observe()  # sustained -> trip
+    monitor.reset()
+    assert monitor.baseline is None  # new serving normal
+
+
+def test_rollback_monitor_ratio_negative_baseline():
+    # Episode returns in this env are negative penalty sums; the ratio
+    # limit must sit on the breach side of a negative baseline (a
+    # multiplicative limit flips sides and trips on healthy samples).
+    values = {"return": -10.0}
+    monitor = RollbackMonitor(
+        lambda: values, "return", ratio=1.5, direction="below",
+        baseline_samples=1, trip_after=1,
+    )
+    assert not monitor.observe()  # baseline -10
+    assert monitor.limit() == pytest.approx(-15.0)
+    assert not monitor.observe()  # healthy: -10 is above the limit
+    values["return"] = -14.0
+    assert not monitor.observe()  # regressed but within the margin
+    values["return"] = -16.0
+    assert monitor.observe()  # past baseline - |baseline|*(ratio-1)
+
+
+def test_rollback_monitor_absolute_threshold_and_direction():
+    values = {"q": 5.0}
+    below = RollbackMonitor(
+        lambda: values, "q", threshold=1.0, direction="below", trip_after=1
+    )
+    assert not below.observe()
+    values["q"] = 0.5
+    assert below.observe()
+    # Missing metric / failing sampler: skipped, never a trip.
+    none = RollbackMonitor(
+        lambda: {}, "missing", threshold=1.0, trip_after=1
+    )
+    assert not none.observe()
+    with pytest.raises(ValueError):
+        RollbackMonitor(lambda: values, "q")  # no limit configured
+    with pytest.raises(ValueError):
+        RollbackMonitor(lambda: values, "q", ratio=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Coordinator pinned reload (the demotion hook)
+# ---------------------------------------------------------------------------
+
+
+def _train_checkpoints(log_dir, iterations=3, seed=0):
+    """A tiny real training run: returns the checkpoint paths written."""
+    per_iter = 4 * ENV.num_agents * 5
+    trainer = Trainer(
+        ENV,
+        ppo=PPOConfig(n_steps=5, n_epochs=2, batch_size=32),
+        config=TrainConfig(
+            num_formations=4,
+            total_timesteps=iterations * per_iter,
+            save_freq=5,
+            name="pipeline_test",
+            log_dir=str(log_dir),
+            seed=seed,
+        ),
+    )
+    trainer.train()
+    return sorted(
+        log_dir.glob("rl_model_*_steps.msgpack"), key=checkpoint_step
+    )
+
+
+def _sabotage_nan(path):
+    """Corrupt a checkpoint's params with NaN, keeping the architecture
+    (it must LOAD fine and fail the gate on eval, not on restore)."""
+    raw = serialization.msgpack_restore(path.read_bytes())
+    raw["params"] = jax.tree_util.tree_map(
+        lambda x: np.full_like(x, np.nan)
+        if isinstance(x, np.ndarray) and np.issubdtype(x.dtype, np.floating)
+        else x,
+        raw["params"],
+    )
+    _write_atomic(path, raw)
+
+
+def test_reload_pinned_demotes_backward(tmp_path):
+    ckpts = _train_checkpoints(tmp_path, iterations=2)
+    assert len(ckpts) >= 2
+    router, coordinator = fleet_from_checkpoint_dir(
+        tmp_path, env_params=ENV, act_dim=ENV.act_dim,
+        num_replicas=2, buckets=(1, 8),
+    )
+    steps = [checkpoint_step(p) for p in ckpts]
+    with router:
+        warmup_fleet(router, (ENV.obs_dim,))
+        assert coordinator.fleet_step == steps[-1]
+        # Monotonic pinned reload refuses to go backward…
+        assert not coordinator.reload_pinned(ckpts[0], monotonic=True)
+        assert coordinator.fleet_step == steps[-1]
+        # …the demotion hook does it, at the fleet batch barrier.
+        assert coordinator.reload_pinned(ckpts[0], monotonic=False)
+        assert coordinator.fleet_step == steps[0]
+        obs = np.zeros((2, ENV.obs_dim), np.float32)
+        res = router.submit(obs).result(timeout=30.0)
+        assert res.model_step == steps[0]
+        # Same-step pin is a no-op, not a swap.
+        assert not coordinator.reload_pinned(ckpts[0], monotonic=False)
+
+
+def test_deferred_promotion_and_failed_rollback(tmp_path):
+    """A wedged replica aborts the batch-barrier commit: a passing
+    candidate must be DEFERRED (never logged 'promoted', never the gate
+    baseline) until the commit lands, and a tripped rollback whose
+    pinned reload cannot commit must log 'rollback_failed' and keep the
+    alarm armed for a retry — the audit log never claims a swap the
+    fleet did not serve."""
+    log_dir = tmp_path / "run"
+    ckpts = _train_checkpoints(log_dir, iterations=2)
+    s1, s2 = checkpoint_step(ckpts[0]), checkpoint_step(ckpts[-1])
+    pipeline = AlwaysLearningPipeline(
+        log_dir,
+        ENV,
+        gate_config=GateConfig(
+            scenarios=("wind",), severities=(1.0,), eval_formations=8,
+            clean_tolerance=10.0, rung_tolerance=10.0,
+        ),
+        poll_interval_s=0.01,
+    )
+    # Bootstrap consumes ONLY the first candidate; s2 stays queued.
+    assert pipeline.wait_first_promotion(timeout_s=120.0)
+    router, coordinator = fleet_from_checkpoint_dir(
+        pipeline.promoted_dir, env_params=ENV, act_dim=ENV.act_dim,
+        num_replicas=2, buckets=(1,),
+    )
+    coordinator.commit_timeout_s = 0.2
+    with router:
+        warmup_fleet(router, (ENV.obs_dim,))
+        pipeline.attach_fleet(router, coordinator)
+        served = {"v": 0.0}
+        pipeline.attach_monitor(
+            RollbackMonitor(lambda: served, "v", threshold=10.0,
+                            trip_after=1)
+        )
+        wedged = router.replicas[1].registry.batch_lock
+        wedged.acquire()  # a worker stuck inside a device dispatch
+        try:
+            pipeline.poll_once()  # s2 passes the gate, commit aborts
+        finally:
+            wedged.release()
+        assert [r.step for r in pipeline.promotions] == [s1]
+        assert pipeline.gate.baseline_step == s1
+        assert coordinator.fleet_step == s1
+        events = [
+            r["event"] for r in PromotionLog.read(
+                log_dir / "promotions.jsonl"
+            )
+        ]
+        assert events.count("promotion_deferred") == 1
+        assert events.count("promoted") == 1  # only s1
+        # Barrier clear -> the next poll retries and the commit lands.
+        pipeline.poll_once()
+        assert [r.step for r in pipeline.promotions] == [s1, s2]
+        assert pipeline.gate.baseline_step == s2
+        assert coordinator.fleet_step == s2
+        # Tripped rollback against a wedged fleet: the demotion cannot
+        # commit — truthfully 'rollback_failed', state restored, alarm
+        # still armed.
+        served["v"] = 100.0
+        wedged.acquire()
+        try:
+            pipeline.poll_once()
+        finally:
+            wedged.release()
+        assert pipeline.rollbacks == []
+        assert coordinator.fleet_step == s2
+        events = [
+            r["event"] for r in PromotionLog.read(
+                log_dir / "promotions.jsonl"
+            )
+        ]
+        assert events.count("rollback_failed") == 1
+        # Cleared wedge + still-breaching metric -> the retry demotes.
+        pipeline.poll_once()
+        assert len(pipeline.rollbacks) == 1
+        assert coordinator.fleet_step == s1
+        assert pipeline.gate.baseline_step == s1
+
+
+def test_leapfrogged_deferred_candidate_is_superseded_not_promoted(
+    tmp_path,
+):
+    """Two candidates defer behind a wedged barrier; when it clears, the
+    coordinator commits straight to the NEWEST — the older deferred
+    candidate never served and must terminate as 'promotion_superseded'
+    (never a baseline, never a rollback target), not be back-filled as
+    'promoted'."""
+    log_dir = tmp_path / "run"
+    ckpts = _train_checkpoints(log_dir, iterations=3)
+    steps = [checkpoint_step(p) for p in ckpts]
+    s1, s2, s3 = steps[0], steps[1], steps[-1]
+    pipeline = AlwaysLearningPipeline(
+        log_dir,
+        ENV,
+        gate_config=GateConfig(
+            scenarios=("wind",), severities=(1.0,), eval_formations=8,
+            clean_tolerance=10.0, rung_tolerance=10.0,
+        ),
+        poll_interval_s=0.01,
+    )
+    assert pipeline.wait_first_promotion(timeout_s=120.0)
+    router, coordinator = fleet_from_checkpoint_dir(
+        pipeline.promoted_dir, env_params=ENV, act_dim=ENV.act_dim,
+        num_replicas=2, buckets=(1,),
+    )
+    coordinator.commit_timeout_s = 0.2
+    with router:
+        warmup_fleet(router, (ENV.obs_dim,))
+        pipeline.attach_fleet(router, coordinator)
+        wedged = router.replicas[1].registry.batch_lock
+        wedged.acquire()
+        try:
+            pipeline.poll_once()  # s2 AND s3 pass the gate, both defer
+        finally:
+            wedged.release()
+        assert [r.step for r in pipeline.promotions] == [s1]
+        assert len(pipeline._deferred) == 2
+        pipeline.poll_once()  # retry: commit jumps straight to s3
+        assert coordinator.fleet_step == s3
+        assert [r.step for r in pipeline.promotions] == [s1, s3]
+        assert pipeline.gate.baseline_step == s3
+        assert pipeline._deferred == []
+    records = PromotionLog.read(log_dir / "promotions.jsonl")
+    superseded = [
+        r for r in records if r["event"] == "promotion_superseded"
+    ]
+    assert [r["step"] for r in superseded] == [s2]
+    promoted = [r for r in records if r["event"] == "promoted"]
+    assert [r["step"] for r in promoted] == [s1, s3]
+
+
+def test_gate_rejects_non_checkpoint_path(tmp_path):
+    """evaluate() honors its never-raises contract even for a filename
+    checkpoint_step cannot parse."""
+    from marl_distributedformation_tpu.pipeline import PromotionGate
+
+    gate = PromotionGate(ENV, GateConfig())
+    weird = tmp_path / "rl_model_final.msgpack"
+    weird.write_bytes(b"x")
+    verdict = gate.evaluate(weird)
+    assert not verdict.passed
+    assert "not a checkpoint path" in verdict.reasons[0]
+
+
+def test_gate_rebase_survives_evicted_history():
+    """A demotion cascade longer than the bounded baseline history must
+    degrade to bootstrap judging, not KeyError the control plane."""
+    from marl_distributedformation_tpu.pipeline import (
+        GateVerdict,
+        PromotionGate,
+    )
+
+    gate = PromotionGate(ENV, GateConfig())
+    for step in range(10, 110, 10):  # 10 promotions, history keeps 8
+        gate.accept(
+            GateVerdict(
+                step=step, path=f"rl_model_{step}_steps.msgpack",
+                passed=True, reasons=[], clean={METRIC: 1.0},
+                cells=_cells(1.0), baseline_step=None,
+                eval_compiles=1, eval_seconds=0.0,
+            )
+        )
+    gate.rebase(10)  # long since evicted
+    assert gate.baseline_step == 10
+    # Bootstrap judging: a finite candidate passes, NaN still rejected.
+    assert judge_candidate(
+        METRIC, {METRIC: 5.0}, _cells(5.0),
+        gate._baseline_clean, gate._baseline_cells, 0.05, 0.10,
+    ) == []
+    gate.rebase(100)  # still in history: full baseline restored
+    assert gate._baseline_clean == {METRIC: 1.0}
+
+
+# ---------------------------------------------------------------------------
+# End to end: trainer -> gate -> fleet, sabotage + rollback
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_end_to_end(tmp_path):
+    assert len(jax.local_devices()) >= 2  # the conftest mesh
+
+    log_dir = tmp_path / "run"
+    ckpts = _train_checkpoints(log_dir, iterations=3)
+    assert len(ckpts) >= 3
+    steps = [checkpoint_step(p) for p in ckpts]
+    s1, s_bad, s3 = steps[0], steps[1], steps[-1]
+    _sabotage_nan(ckpts[1])
+
+    # Tolerances are wide: this run is 3 tiny PPO iterations, so honest
+    # candidates wobble — the sabotage is caught by the FINITE check,
+    # which no tolerance can launder.
+    pipeline = AlwaysLearningPipeline(
+        log_dir,
+        ENV,
+        gate_config=GateConfig(
+            scenarios=("wind",),
+            severities=(1.0,),
+            eval_formations=8,
+            clean_tolerance=10.0,
+            rung_tolerance=10.0,
+        ),
+        poll_interval_s=0.01,
+    )
+
+    # Bootstrap: the first candidate passes and is published.
+    assert pipeline.wait_first_promotion(timeout_s=120.0)
+    assert pipeline.promotions[0].step == s1
+    assert set(pipeline.promoter.published_steps()) == {s1}
+
+    # Fleet boots from the PROMOTED directory only.
+    router, coordinator = fleet_from_checkpoint_dir(
+        pipeline.promoted_dir, env_params=ENV, act_dim=ENV.act_dim,
+        num_replicas=2, buckets=(1, 8),
+    )
+    with router:
+        warmup_fleet(router, (ENV.obs_dim,))
+        # Watching the raw trainer dir is the vulnerability this
+        # subsystem closes — refuse it loudly.
+        with pytest.raises(ValueError):
+            pipeline.attach_fleet(
+                router,
+                type(coordinator)(log_dir, router),
+            )
+        pipeline.attach_fleet(router, coordinator)
+        served = {"v": 0.0}
+        monitor = RollbackMonitor(
+            lambda: served, "v", threshold=10.0, trip_after=1
+        )
+        pipeline.attach_monitor(monitor)
+
+        def served_step():
+            obs = np.zeros((2, ENV.obs_dim), np.float32)
+            return router.submit(obs).result(timeout=30.0).model_step
+
+        assert served_step() == s1
+
+        # Drain the remaining candidates: the sabotaged one is rejected,
+        # the rest promote in step order.
+        while pipeline.poll_once():
+            pass
+        assert [v.step for v in pipeline.rejections] == [s_bad]
+        assert "non-finite" in pipeline.rejections[0].reasons[0]
+        assert [r.step for r in pipeline.promotions] == [
+            s for s in steps if s != s_bad
+        ]
+        # The sabotaged step was never published, never served.
+        assert s_bad not in pipeline.promoter.published_steps()
+        assert coordinator.fleet_step == s3
+        assert served_step() == s3
+        # Promotion latency measured for every post-fleet promotion.
+        assert all(
+            r.latency_s is not None and r.latency_s >= 0.0
+            for r in pipeline.promotions[1:]
+        )
+
+        # Forced serving-metric regression -> rollback to last-good.
+        served["v"] = 100.0
+        pipeline.poll_once()
+        assert len(pipeline.rollbacks) == 1
+        assert pipeline.rollbacks[0]["from_step"] == s3
+        assert pipeline.rollbacks[0]["to_step"] == s1
+        assert coordinator.fleet_step == s1
+        assert served_step() == s1
+        # Retraction: the demoted checkpoint left the promoted dir, so
+        # the coordinator's next poll cannot re-promote it.
+        assert set(pipeline.promoter.published_steps()) == {s1}
+        assert not coordinator.refresh()
+        assert coordinator.fleet_step == s1
+        # The gate judges future candidates against what serves AGAIN.
+        assert pipeline.gate.baseline_step == s1
+
+    # THE compile-once receipt: one gate eval program across every
+    # candidate — bootstrap, sabotage, promotions — and it is recorded
+    # in the verdict log.
+    assert pipeline.gate.program.compile_count == 1
+    records = PromotionLog.read(log_dir / "promotions.jsonl")
+    events = [r["event"] for r in records]
+    assert events.count("promoted") == len(pipeline.promotions)
+    assert events.count("rejected") == 1
+    assert events.count("rolled_back") == 1
+    for r in records:
+        assert r["schema"] == PROMOTIONS_SCHEMA
+        if r["event"] in ("promoted", "rejected"):
+            assert r["gate_eval_compiles"] == 1
+    rolled = [r for r in records if r["event"] == "rolled_back"][0]
+    assert rolled["from_step"] == s3 and rolled["to_step"] == s1
+    # Serving-side receipt: the swaps + demotion never recompiled.
+    assert all(
+        count <= 1
+        for per in router.compile_counts().values()
+        for count in per.values()
+    )
+    # Summary carries the bench fields.
+    summary = pipeline.summary()
+    assert summary["gate_eval_compiles"] == 1
+    assert summary["promotions"] == len(pipeline.promotions)
+    assert summary["rollbacks"] == 1
+    assert summary["gate_eval_steps_per_sec"] > 0
