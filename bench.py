@@ -1189,6 +1189,16 @@ def main() -> None:
                     result["pipeline_serving_max_compiles_per_rung"] = int(
                         rep["serving_max_compiles_per_rung"]
                     )
+                    # Phase 8's span decomposition (obs/): per-stage
+                    # p50s over the run's traced promotions — where the
+                    # promotion seconds actually go (stream poll vs gate
+                    # eval vs publish vs barrier commit vs first serve).
+                    breakdown = rep.get("promotion_span_breakdown")
+                    if breakdown:
+                        result["promotion_span_breakdown"] = {
+                            str(k): round(float(v), 4)
+                            for k, v in breakdown.items()
+                        }
                     print(
                         "[bench] pipeline (train->gate->fleet, 2-replica "
                         f"CPU): {rep['promotions']} promotions, "
@@ -1202,6 +1212,87 @@ def main() -> None:
                     notes.append(f"pipeline phase failed: {e!r}"[:200])
             else:
                 notes.append("pipeline phase skipped: deadline")
+        # Phase 8 — tracing overhead (obs/, docs/observability.md): the
+        # phase-6 fleet smoke run twice back to back at equal duration,
+        # obs tracing ON then OFF; tracing_overhead_pct is the relative
+        # req/s cost of leaving the spine enabled on the serving hot
+        # path (the ISSUE 8 bar is < 5% — one ring append per coalesced
+        # batch, not per request, is why it holds). Same subprocess /
+        # forced-2-device rationale as phase 6. The companion
+        # promotion_span_breakdown field rides phase 7's pipeline rep.
+        if os.environ.get("BENCH_SKIP_SERVING") != "1":
+            if time.time() < deadline - 60:
+                try:
+                    obs_s = float(
+                        os.environ.get("BENCH_OBS_DURATION_S", 2.0)
+                    )
+                    env = dict(os.environ)
+                    env["JAX_PLATFORMS"] = "cpu"
+                    env["XLA_FLAGS"] = (
+                        env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                    ).strip()
+                    # Best-of-N INTERLEAVED passes, the phase-5b
+                    # rationale: back-to-back per-mode timing on a
+                    # shared container books load drift to whichever
+                    # mode hit the bad window; interleaving + best-of
+                    # cancels it.
+                    passes = _env_int("BENCH_OBS_PASSES", 2)
+                    rates = {"on": 0.0, "off": 0.0}
+                    for _ in range(max(1, passes)):
+                        for mode in ("on", "off"):
+                            cmd = [
+                                sys.executable,
+                                os.path.join(
+                                    os.path.dirname(
+                                        os.path.abspath(__file__)
+                                    ),
+                                    "scripts", "serve_policy.py",
+                                ),
+                                "--init-policy", "MLPActorCritic",
+                                "--obs-dim", "8",
+                                "--fleet", "--replicas", "2",
+                                "--smoke",
+                                "--duration", str(obs_s),
+                                "--obs-trace", mode,
+                            ]
+                            out = subprocess.run(
+                                cmd, capture_output=True, text=True,
+                                timeout=max(deadline - time.time(), 60),
+                                env=env,
+                            )
+                            if out.returncode != 0:
+                                raise RuntimeError(
+                                    f"obs-{mode} smoke exited "
+                                    f"{out.returncode}: "
+                                    + out.stderr[-200:]
+                                )
+                            rep = json.loads(
+                                out.stdout.strip().splitlines()[-1]
+                            )
+                            rates[mode] = max(
+                                rates[mode],
+                                float(rep["requests_per_sec_fleet"]),
+                            )
+                    overhead = (
+                        100.0 * (rates["off"] - rates["on"]) / rates["off"]
+                    )
+                    result["tracing_overhead_pct"] = round(overhead, 2)
+                    result["tracing_smoke_req_s_on"] = round(rates["on"], 1)
+                    result["tracing_smoke_req_s_off"] = round(
+                        rates["off"], 1
+                    )
+                    print(
+                        "[bench] tracing overhead (2-replica CPU smoke): "
+                        f"{rates['on']:,.0f} req/s traced vs "
+                        f"{rates['off']:,.0f} untraced "
+                        f"({overhead:+.1f}%)",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade, don't die
+                    notes.append(f"obs phase failed: {e!r}"[:200])
+            else:
+                notes.append("obs phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

@@ -32,6 +32,9 @@ from marl_distributedformation_tpu.analysis.rules.scan_carry import (
 from marl_distributedformation_tpu.analysis.rules.sharding_drift import (
     ScanCarryShardingDrift,
 )
+from marl_distributedformation_tpu.analysis.rules.span_scope import (
+    SpanInTracedScope,
+)
 from marl_distributedformation_tpu.analysis.rules.vmap_axes import (
     VmapInAxesArity,
 )
@@ -51,6 +54,7 @@ RULES = (
     CallbackInHotLoop(),
     ScanCarryShardingDrift(),
     CrossModuleCallback(),
+    SpanInTracedScope(),
 )
 
 

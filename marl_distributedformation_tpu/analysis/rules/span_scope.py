@@ -1,0 +1,149 @@
+"""span-in-traced-scope: host-side tracing smuggled into compiled code.
+
+The obs tracing spine (``marl_distributedformation_tpu/obs/``) is
+host-only by contract: spans and events are recorded at dispatch seams
+(scheduler, reload commit, gate eval), never inside the program being
+dispatched. A ``tracer.span(...)`` / ``tracer.event(...)`` call inside
+a jit/vmap/scan traced scope is doubly wrong: at best it records
+trace-time (compile-time) garbage that silently measures nothing; at
+worst the recorded value is a tracer object and the ring fills with
+unreadable reprs — and either way host work has leaked into what must
+stay a pure compiled program. This rule rejects it statically, which is
+what lets every instrumented hot path keep its budget-1 compile receipt
+with tracing enabled: the spine is graftlint-clean by construction.
+
+Detection surfaces (mirroring how the spine is actually called):
+
+- method calls whose receiver chain names a tracer — ``tracer.span``,
+  ``self._tracer.event``, ``obs.get_tracer().incident`` — with the
+  method in the recording set;
+- names imported from an ``obs``/``tracer`` module and called directly
+  (``from ...obs import span``-style helpers, should any appear);
+- one same-module call hop, like rule 12: a traced scope calling a
+  local helper whose body records is the same hazard wearing a
+  function name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+# Recording entry points on a Tracer (obs/tracer.py). incident() dumps
+# the flight recorder — file IO under trace is the worst of the bunch.
+_RECORD_METHODS = frozenset({"span", "event", "add_span", "incident"})
+# Module-path fragments that mark an import as the tracing spine.
+_OBS_MODULE_PARTS = frozenset({"obs", "tracer"})
+
+
+def _is_obs_module(module: str) -> bool:
+    return any(part in _OBS_MODULE_PARTS for part in module.split("."))
+
+
+class SpanInTracedScope(Rule):
+    name = "span-in-traced-scope"
+    default_severity = "error"
+    description = (
+        "obs.Tracer span/event recording reachable inside a jit/scan/"
+        "vmap traced scope — host work smuggled into the compiled "
+        "program; record at the dispatch seam instead"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        obs_names = self._obs_imports(ctx.tree)
+        reported: Set[Tuple[int, int]] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.enclosing_traced_scope(node) is None:
+                continue
+            hit = self._record_call(ctx, node, obs_names)
+            if hit and (node.lineno, node.col_offset) not in reported:
+                reported.add((node.lineno, node.col_offset))
+                yield (
+                    node.lineno,
+                    node.col_offset,
+                    f"{hit} inside a traced scope records at trace time "
+                    "(or worse, per compiled iteration) — tracing is "
+                    "host-side only; move the span to the dispatch seam "
+                    "around the jitted call",
+                )
+
+    # -- import surface ---------------------------------------------------
+
+    @staticmethod
+    def _obs_imports(tree: ast.Module) -> Set[str]:
+        """Local names bound from obs/tracer modules: both
+        ``from ...obs import get_tracer`` targets and ``import ...obs
+        as o`` aliases."""
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if _is_obs_module(node.module or ""):
+                    for alias in node.names:
+                        if alias.name != "*":
+                            names.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if _is_obs_module(alias.name):
+                        names.add(alias.asname or alias.name.split(".")[0])
+        return names
+
+    # -- call classification ----------------------------------------------
+
+    def _record_call(
+        self, ctx: ModuleContext, node: ast.Call, obs_names: Set[str]
+    ) -> Optional[str]:
+        """A human-readable description when this call records to the
+        tracing spine (directly or one same-module hop away); else None."""
+        direct = self._direct_record(node, obs_names)
+        if direct:
+            return direct
+        # One call hop: a traced scope calling a same-module helper that
+        # records (rule 12's reachability idiom).
+        if isinstance(node.func, ast.Name):
+            for definition in ctx._defs_by_name.get(node.func.id, ()):
+                for inner in ast.walk(definition):
+                    if isinstance(inner, ast.Call):
+                        hit = self._direct_record(inner, obs_names)
+                        if hit:
+                            return (
+                                f"{node.func.id}() reaches {hit}"
+                            )
+        return None
+
+    def _direct_record(
+        self, node: ast.Call, obs_names: Set[str]
+    ) -> Optional[str]:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr not in _RECORD_METHODS:
+                return None
+            receiver = func.value
+            # get_tracer().span(...) / obs.get_tracer().event(...)
+            if isinstance(receiver, ast.Call):
+                rname = dotted_name(receiver.func) or ""
+                if rname.split(".")[-1] == "get_tracer" or (
+                    rname.split(".")[0] in obs_names
+                ):
+                    return f"{rname}().{func.attr}(...)"
+                return None
+            rname = dotted_name(receiver)
+            if rname is None:
+                return None
+            parts = rname.split(".")
+            if any("tracer" in p.lower() for p in parts) or (
+                parts[0] in obs_names
+            ):
+                return f"{rname}.{func.attr}(...)"
+            return None
+        if isinstance(func, ast.Name):
+            if func.id in obs_names and func.id in _RECORD_METHODS:
+                return f"{func.id}(...)"
+        return None

@@ -1,0 +1,70 @@
+"""Unified tracing spine: trace-ID propagation, spans, flight recorder.
+
+One observability substrate shared by every subsystem (docs/
+observability.md has the span taxonomy and the propagation diagram):
+
+- :class:`~.tracer.Tracer` — lock-cheap per-thread ring buffers of
+  spans/events on a monotonic clock, with a process-global registry
+  (:func:`get_tracer` / :func:`configure`). Recording sits strictly on
+  host-side seams; graftlint rule 15 (``span-in-traced-scope``) rejects
+  any span/event call reachable inside a compiled scope, so tracing can
+  never perturb the budget-1 compile receipts.
+- **Trace-context propagation** — an ``X-Trace-Id`` header accepted and
+  echoed by the fleet frontend, carried through
+  ``FleetRouter.submit -> MicroBatchScheduler -> engine dispatch``
+  (batch spans link the coalesced request IDs), and a pipeline trace ID
+  minted per candidate checkpoint that follows it through stream ->
+  gate -> publish -> barrier commit -> first served response, so ONE
+  trace reconstructs a promotion end to end (``promotions.jsonl``
+  schema 2 carries ``trace_id`` + the span decomposition).
+- **Exporters** (:mod:`~.export`) — Chrome trace-event JSON
+  (Perfetto-loadable, ``scripts/trace_report.py``) and Prometheus text
+  exposition (content-negotiated on the fleet's ``GET /v1/metrics``).
+- :class:`~.flightrec.FlightRecorder` — incident-triggered last-N
+  snapshots (circuit break, rollback trip, wedged-barrier abort,
+  scheduler worker death) to ``flightrec-*.json``, so postmortems don't
+  depend on having had logging enabled.
+
+This package never imports jax — it is pure host-side bookkeeping and
+stays importable from the lint CLI and any frontend process.
+"""
+
+from marl_distributedformation_tpu.obs.export import (  # noqa: F401
+    PROMETHEUS_CONTENT_TYPE,
+    chrome_trace,
+    escape_label_value,
+    prometheus_exposition,
+    wants_prometheus,
+)
+from marl_distributedformation_tpu.obs.flightrec import (  # noqa: F401
+    FlightRecorder,
+)
+from marl_distributedformation_tpu.obs.tracer import (  # noqa: F401
+    TRACE_HEADER,
+    Event,
+    Span,
+    Tracer,
+    configure,
+    get_tracer,
+    new_trace_id,
+    sanitize_trace_id,
+    set_tracer,
+)
+
+__all__ = [
+    "Event",
+    "FlightRecorder",
+    "PROMETHEUS_CONTENT_TYPE",
+    "Span",
+    "TRACE_HEADER",
+    "Tracer",
+    "chrome_trace",
+    "configure",
+    "escape_label_value",
+    "get_tracer",
+    "new_trace_id",
+    "prometheus_exposition",
+    "sanitize_trace_id",
+    "set_tracer",
+    "wants_prometheus",
+]

@@ -1,0 +1,210 @@
+"""Exporters: Chrome trace-event JSON and Prometheus text exposition.
+
+Two read-side formats over the same data:
+
+- :func:`chrome_trace` turns a tracer snapshot (``Tracer.snapshot()`` /
+  a ``Tracer.dump`` file's ``records``) into the Chrome trace-event
+  format Perfetto and ``chrome://tracing`` load — one lane (tid) per
+  recording thread, complete-events (``ph: "X"``) for spans, instants
+  (``ph: "i"``) for events, trace IDs and attrs in ``args``. Timestamps
+  are epoch microseconds, so a file produced here merges cleanly
+  alongside ``TraceWindow``'s XLA captures in the same viewer session.
+- :func:`prometheus_exposition` renders any flat ``{name: float}``
+  snapshot (the shape every metrics object in this repo already emits)
+  as Prometheus text format 0.0.4: ``# TYPE`` lines, ``_total`` keys as
+  counters, everything else as gauges, ``replica{i}_*`` keys folded into
+  one metric with a ``replica`` label, label values escaped per the
+  exposition spec. ``serving/fleet/frontend.py`` serves it from
+  ``GET /v1/metrics`` under content negotiation (JSON stays the
+  default).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ----------------------------------------------------------------------
+# Chrome trace events
+# ----------------------------------------------------------------------
+
+
+def chrome_trace(
+    records: Iterable[dict], process_name: str = "marl-obs"
+) -> dict:
+    """Chrome trace-event JSON (object form) from tracer snapshot
+    records. Unknown/malformed records are skipped, not fatal — a
+    partially-scrolled ring must still render."""
+    lanes: Dict[str, int] = {}
+    events: List[dict] = []
+
+    def lane(thread: str) -> int:
+        if thread not in lanes:
+            lanes[thread] = len(lanes) + 1
+        return lanes[thread]
+
+    for rec in records:
+        try:
+            name = str(rec["name"])
+            tid = lane(str(rec.get("thread", "main")))
+            ts = float(rec["t0"]) * 1e6
+            args = dict(rec.get("attrs") or {})
+            if rec.get("trace_id"):
+                args["trace_id"] = rec["trace_id"]
+            if rec.get("kind") == "span":
+                dur = max(0.0, float(rec["t1"]) - float(rec["t0"])) * 1e6
+                events.append(
+                    {
+                        "name": name,
+                        "ph": "X",
+                        "ts": ts,
+                        "dur": dur,
+                        "pid": 1,
+                        "tid": tid,
+                        "args": args,
+                    }
+                )
+            else:
+                events.append(
+                    {
+                        "name": name,
+                        "ph": "i",
+                        "s": "t",
+                        "ts": ts,
+                        "pid": 1,
+                        "tid": tid,
+                        "args": args,
+                    }
+                )
+        except (KeyError, TypeError, ValueError):
+            continue
+    meta: List[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "args": {"name": process_name},
+        }
+    ]
+    for thread, tid in lanes.items():
+        meta.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": thread},
+            }
+        )
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+# ----------------------------------------------------------------------
+# Prometheus text exposition (format 0.0.4)
+# ----------------------------------------------------------------------
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_REPLICA_KEY = re.compile(r"^replica(\d+)_(.+)$")
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(key: str, namespace: str) -> str:
+    name = _NAME_OK.sub("_", f"{namespace}_{key}" if namespace else key)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the exposition format: backslash, double
+    quote, and newline."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _render_value(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def prometheus_exposition(
+    snapshot: Dict[str, float],
+    namespace: str = "marl",
+    labels: Optional[Dict[str, str]] = None,
+) -> str:
+    """Render a flat float snapshot as Prometheus text format.
+
+    ``replica{i}_{metric}`` keys fold into one ``{metric}`` family with
+    a ``replica="i"`` label (per-replica series belong under one metric
+    name, not N names). ``*_total`` keys are typed ``counter``, the rest
+    ``gauge``. Non-numeric values are skipped — a snapshot is allowed to
+    carry annotations without breaking the scrape."""
+    base_labels = [
+        (k, str(v)) for k, v in sorted((labels or {}).items())
+    ]
+    # metric name -> (type, [(label pairs, value), ...]) preserving the
+    # first-seen order of families.
+    families: Dict[str, Tuple[str, List[Tuple[List[Tuple[str, str]], float]]]] = {}
+    for key, value in snapshot.items():
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            continue
+        m = _REPLICA_KEY.match(key)
+        if m:
+            metric, extra = m.group(2), [("replica", m.group(1))]
+        else:
+            metric, extra = key, []
+        name = _metric_name(metric, namespace)
+        kind = "counter" if metric.endswith("_total") else "gauge"
+        fam = families.setdefault(name, (kind, []))
+        fam[1].append((base_labels + extra, v))
+    lines: List[str] = []
+    for name, (kind, series) in families.items():
+        lines.append(f"# TYPE {name} {kind}")
+        for pairs, v in series:
+            if pairs:
+                rendered = ",".join(
+                    f'{k}="{escape_label_value(v_)}"' for k, v_ in pairs
+                )
+                lines.append(f"{name}{{{rendered}}} {_render_value(v)}")
+            else:
+                lines.append(f"{name} {_render_value(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def wants_prometheus(accept_header: Optional[str]) -> bool:
+    """Content negotiation for ``GET /v1/metrics``: Prometheus text only
+    when the client PREFERS it (``text/plain`` or an openmetrics type
+    outranking ``application/json`` by q-value in ``Accept``);
+    bare/absent/wildcard Accept keeps the JSON default, so every
+    existing client is untouched. Media ranges are parsed, not
+    substring-matched — a JSON client sending a compound header like
+    ``application/json, text/plain, */*`` (axios's default) still gets
+    JSON; ties go to the JSON default."""
+    if not accept_header:
+        return False
+    prom_q = 0.0
+    json_q = 0.0
+    for media_range in accept_header.lower().split(","):
+        parts = media_range.split(";")
+        mtype = parts[0].strip()
+        q = 1.0
+        for param in parts[1:]:
+            k, _, v = param.partition("=")
+            if k.strip() == "q":
+                try:
+                    q = float(v.strip())
+                except ValueError:
+                    q = 0.0
+        if mtype in ("text/plain", "application/openmetrics-text"):
+            prom_q = max(prom_q, q)
+        elif mtype == "application/json":
+            json_q = max(json_q, q)
+    return prom_q > 0.0 and prom_q > json_q

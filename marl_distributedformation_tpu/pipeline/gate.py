@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.eval import episode_length
+from marl_distributedformation_tpu.obs import get_tracer
 from marl_distributedformation_tpu.utils.checkpoint import checkpoint_step
 
 # Cells: {scenario: {"{severity:g}": {metric: float}}}
@@ -189,11 +190,15 @@ class PromotionGate:
     def baseline_step(self) -> Optional[int]:
         return self._baseline_step
 
-    def evaluate(self, path: str | Path) -> GateVerdict:
+    def evaluate(
+        self, path: str | Path, trace_id: Optional[str] = None
+    ) -> GateVerdict:
         """Run one candidate through the matrix + regression checks.
         Never raises for a bad candidate — unloadable / wrong-
         architecture / non-finite candidates are failed verdicts with
-        the reason recorded."""
+        the reason recorded. ``trace_id`` labels the eval span (obs/)
+        so the gate leg of a promotion trace carries the candidate's
+        identity."""
         from marl_distributedformation_tpu.compat.policy import LoadedPolicy
         from marl_distributedformation_tpu.scenarios.matrix import (
             MatrixProgram,
@@ -235,10 +240,20 @@ class PromotionGate:
                     seed=cfg.eval_seed,
                 )
             t0 = time.perf_counter()
-            clean = self.program.evaluate_clean(pol.params, origin=str(path))
-            cells = self.program.evaluate_cells(
-                pol.params, cfg.scenarios, cfg.severities, origin=str(path)
-            )
+            # The span wraps the compiled MatrixProgram calls from the
+            # HOST side (dispatch + drain) — recording happens after the
+            # program returns, never inside it (graftlint rule 15).
+            with get_tracer().span(
+                "gate.matrix_eval", trace_id=trace_id, step=step,
+                cells=1 + len(cfg.scenarios) * len(cfg.severities),
+            ):
+                clean = self.program.evaluate_clean(
+                    pol.params, origin=str(path)
+                )
+                cells = self.program.evaluate_cells(
+                    pol.params, cfg.scenarios, cfg.severities,
+                    origin=str(path),
+                )
         except Exception as e:  # noqa: BLE001 — a bad candidate must
             # never kill the pipeline; it is a rejected verdict.
             return GateVerdict(

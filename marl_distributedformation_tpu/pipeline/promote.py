@@ -30,7 +30,20 @@ from marl_distributedformation_tpu.utils.checkpoint import checkpoint_step
 
 # Bump when the line shape changes; scripts/check_bench_record.py and the
 # schema unit test pin the current shape.
-PROMOTIONS_SCHEMA = 1
+#
+# Schema history:
+#   1 — PR 7: event/time/step/checkpoint + gate verdict payload.
+#   2 — obs spine: verdict-bearing lines additionally carry ``trace_id``
+#       (the candidate's promotion trace, minted by the supervisor) and
+#       promoted lines a ``spans`` dict — the per-stage decomposition
+#       (``stream_poll_s`` / ``gate_eval_s`` / ``publish_s`` /
+#       ``barrier_commit_s`` / ``first_serve_s`` [+ ``deferred_wait_s``])
+#       whose values sum to ``promotion_latency_s`` (within clock skew).
+PROMOTIONS_SCHEMA = 2
+
+# Schemas the reader accepts. Schema-1 lines (pre-obs runs) stay
+# readable forever: the reader backfills ``trace_id``/``spans`` as None.
+READABLE_SCHEMAS = (1, 2)
 
 
 class PromotionLog:
@@ -58,14 +71,32 @@ class PromotionLog:
 
     @staticmethod
     def read(path: str | Path) -> List[dict]:
+        """Every record in the log, oldest first. Accepts all
+        ``READABLE_SCHEMAS`` — schema-1 lines come back with
+        ``trace_id``/``spans`` backfilled to None so readers written
+        against schema 2 need no per-line branching. A line stamped
+        with an UNKNOWN schema raises: silently misreading a future
+        shape is worse than failing loudly."""
         p = Path(path)
         if not p.exists():
             return []
-        return [
-            json.loads(line)
-            for line in p.read_text().splitlines()
-            if line.strip()
-        ]
+        records: List[dict] = []
+        for line in p.read_text().splitlines():
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            schema = rec.get("schema", 1)
+            if schema not in READABLE_SCHEMAS:
+                raise ValueError(
+                    f"promotions.jsonl line has schema {schema!r}; this "
+                    f"reader understands {READABLE_SCHEMAS} — upgrade "
+                    "the reader before consuming this log"
+                )
+            if schema < 2:
+                rec.setdefault("trace_id", None)
+                rec.setdefault("spans", None)
+            records.append(rec)
+        return records
 
 
 class Promoter:

@@ -34,7 +34,10 @@ import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from marl_distributedformation_tpu.env import EnvParams
+from marl_distributedformation_tpu.obs import get_tracer, new_trace_id
 from marl_distributedformation_tpu.pipeline.gate import (
     GateConfig,
     GateVerdict,
@@ -57,6 +60,37 @@ class PromotionRecord:
     source: str
     promoted: str
     latency_s: Optional[float]  # None before a fleet is attached
+    trace_id: Optional[str] = None  # the candidate's promotion trace
+    spans: Optional[Dict[str, float]] = None  # per-stage decomposition
+
+
+class _PromotionTrace:
+    """One candidate's trace identity plus its stage clock.
+
+    The stages are the promotion-latency decomposition the obs spine
+    exists to measure (ISSUE 8): ``stream_poll_s`` (durable write ->
+    gate start, including the poll interval and any queue wait behind
+    earlier candidates), ``gate_eval_s``, ``publish_s``,
+    ``barrier_commit_s``, ``first_serve_s`` (commit -> a post-commit
+    dispatch answering with this step), and — only when a wedged commit
+    deferred the candidate — ``deferred_wait_s``. The measurement points
+    are back-to-back in ``process_candidate``, so the stage sum tracks
+    ``promotion_latency_s`` to within clock-read noise."""
+
+    def __init__(self, path: Path) -> None:
+        self.trace_id = new_trace_id()
+        self.stages: Dict[str, float] = {}
+        self.deferred_at: Optional[float] = None
+        try:
+            self.t_write: Optional[float] = path.stat().st_mtime
+        except OSError:
+            self.t_write = None
+
+    def add(self, stage: str, seconds: float) -> None:
+        self.stages[stage] = self.stages.get(stage, 0.0) + max(0.0, seconds)
+
+    def rounded(self) -> Dict[str, float]:
+        return {k: round(v, 4) for k, v in self.stages.items()}
 
 
 class AlwaysLearningPipeline:
@@ -72,6 +106,7 @@ class AlwaysLearningPipeline:
         start_after_step: int = -1,
     ) -> None:
         self.log_dir = Path(log_dir)
+        self.env_params = env_params  # sized requests (first-serve probe)
         self.stream = CheckpointStream(
             self.log_dir,
             poll_interval_s=poll_interval_s,
@@ -142,36 +177,102 @@ class AlwaysLearningPipeline:
         wedged replica aborts the barrier swap — reload.py's abort path)
         is 'promotion_deferred', not 'promoted': the baseline, the
         good-stack, and the audit log only ever advance to checkpoints
-        that actually serve; the commit is retried on later polls."""
-        verdict = self.gate.evaluate(path)
+        that actually serve; the commit is retried on later polls.
+
+        Every candidate gets ONE trace ID (obs/) that labels the gate
+        eval span, the reload barrier spans, the first-serve batch span,
+        and the ``promotions.jsonl`` line — one trace reconstructs the
+        whole promotion."""
+        tracer = get_tracer()
+        tr = _PromotionTrace(path)
+        t_gate_start = time.time()
+        if tr.t_write is not None:
+            # On-disk wait from durable write to gate pickup — back-dated
+            # to the checkpoint's mtime on the tracer's shared clock.
+            tr.add("stream_poll_s", t_gate_start - tr.t_write)
+            tracer.add_span(
+                "promotion.stream_poll",
+                tracer.epoch_to_mono(tr.t_write),
+                tracer.epoch_to_mono(t_gate_start),
+                trace_id=tr.trace_id,
+                path=str(path),
+            )
+        t0 = time.perf_counter()
+        with tracer.span("promotion.gate_eval", trace_id=tr.trace_id):
+            verdict = self.gate.evaluate(path, trace_id=tr.trace_id)
+        tr.add("gate_eval_s", time.perf_counter() - t0)
         if not verdict.passed:
             self.rejections.append(verdict)
-            self.log.append("rejected", **verdict.record())
+            self.log.append(
+                "rejected", **verdict.record(), trace_id=tr.trace_id
+            )
             return verdict
-        promoted = self.promoter.publish(path)
+        t0 = time.perf_counter()
+        with tracer.span(
+            "promotion.publish", trace_id=tr.trace_id, step=verdict.step
+        ):
+            promoted = self.promoter.publish(path)
+        tr.add("publish_s", time.perf_counter() - t0)
         if self.coordinator is not None:
-            self.coordinator.refresh()
+            t0 = time.perf_counter()
+            with tracer.span(
+                "promotion.barrier_commit", trace_id=tr.trace_id,
+                step=verdict.step,
+            ):
+                self.coordinator.refresh(trace_id=tr.trace_id)
+            tr.add("barrier_commit_s", time.perf_counter() - t0)
             # refresh() may return False for benign reasons (a started
             # background watcher raced us to the swap) — what matters is
             # whether the fleet now serves at least this step.
             if self.coordinator.fleet_step < verdict.step:
-                self._deferred.append((verdict, str(promoted), path))
+                tr.deferred_at = time.time()
+                self._deferred.append((verdict, str(promoted), path, tr))
                 self.log.append(
                     "promotion_deferred",
                     **verdict.record(),
+                    trace_id=tr.trace_id,
                     promoted_path=str(promoted),
                     reason="fleet commit did not land (see coordinator "
                     "load_errors); retrying on later polls",
                 )
                 return verdict
+            self._probe_first_serve(tr, verdict.step)
             # Served wall-clock: from the moment the trainer's write
             # became durable (the file's mtime) to the moment every
-            # post-commit dispatch answers with this step.
+            # post-commit dispatch answers with this step (the probe
+            # above just witnessed one).
             latency = self._latency_since_write(path)
         else:
             latency = None
-        self._finalize_promotion(verdict, str(promoted), path, latency)
+        self._finalize_promotion(verdict, str(promoted), path, latency, tr)
         return verdict
+
+    def _probe_first_serve(self, tr: _PromotionTrace, step: int) -> None:
+        """Witness the first post-commit response at the promoted step:
+        one 1-row request through the router, timed as the
+        ``first_serve`` stage. Best-effort — a probe failure (per-
+        formation row shapes, transient backpressure) leaves the stage
+        unmeasured and never blocks the promotion itself."""
+        if self.router is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            obs = np.zeros((1, self.env_params.obs_dim), np.float32)
+            result = self.router.submit(obs, trace_id=tr.trace_id).result(
+                timeout=self.router.default_timeout_s + 5.0
+            )
+            done = time.perf_counter()
+            tr.add("first_serve_s", done - t0)
+            get_tracer().add_span(
+                "promotion.first_serve",
+                t0,
+                done,
+                trace_id=tr.trace_id,
+                step=step,
+                served_step=int(result.model_step),
+            )
+        except Exception:  # noqa: BLE001 — observability never gates serving
+            pass
 
     @staticmethod
     def _latency_since_write(path: Path) -> Optional[float]:
@@ -187,6 +288,7 @@ class AlwaysLearningPipeline:
         promoted: str,
         path: Path,
         latency: Optional[float],
+        tr: Optional[_PromotionTrace] = None,
     ) -> None:
         """The candidate SERVES (or no fleet is attached yet): install
         it as the gate baseline and the new last-good."""
@@ -196,6 +298,8 @@ class AlwaysLearningPipeline:
             source=str(path),
             promoted=promoted,
             latency_s=latency,
+            trace_id=tr.trace_id if tr is not None else None,
+            spans=tr.rounded() if tr is not None else None,
         )
         self.promotions.append(record)
         self._good.append(record)
@@ -204,6 +308,8 @@ class AlwaysLearningPipeline:
         self.log.append(
             "promoted",
             **verdict.record(),
+            trace_id=record.trace_id,
+            spans=record.spans,
             promoted_path=promoted,
             promotion_latency_s=(
                 round(latency, 4) if latency is not None else None
@@ -221,14 +327,28 @@ class AlwaysLearningPipeline:
         becomes the gate baseline or a rollback target."""
         if not self._deferred or self.coordinator is None:
             return
-        self.coordinator.refresh()
+        # refresh commits the NEWEST published checkpoint — label its
+        # spans with that candidate's trace so the retry leg joins the
+        # same promotion trace as the original attempt.
+        retry_trace = self._deferred[-1][3]
+        # The deferred wait ends where the retry commit begins — snapshot
+        # the boundary BEFORE refresh() so the commit seconds land only
+        # in barrier_commit_s and the stages still sum to the latency.
+        wait_end = time.time()
+        t_retry = time.perf_counter()
+        self.coordinator.refresh(trace_id=retry_trace.trace_id)
+        retry_commit_s = time.perf_counter() - t_retry
         still_deferred = []
-        for verdict, promoted, path in self._deferred:
+        for verdict, promoted, path, tr in self._deferred:
             fleet_step = self.coordinator.fleet_step
             if fleet_step == verdict.step:
+                if tr.deferred_at is not None:
+                    tr.add("deferred_wait_s", wait_end - tr.deferred_at)
+                tr.add("barrier_commit_s", retry_commit_s)
+                self._probe_first_serve(tr, verdict.step)
                 self._finalize_promotion(
                     verdict, promoted, path,
-                    self._latency_since_write(path),
+                    self._latency_since_write(path), tr,
                 )
             elif fleet_step > verdict.step:
                 self.log.append(
@@ -237,9 +357,10 @@ class AlwaysLearningPipeline:
                     checkpoint=verdict.path,
                     reason=f"fleet committed step {fleet_step} while this "
                     "candidate's swap was deferred; it never served",
+                    trace_id=tr.trace_id,
                 )
             else:
-                still_deferred.append((verdict, promoted, path))
+                still_deferred.append((verdict, promoted, path, tr))
         self._deferred = still_deferred
 
     def check_rollback(self) -> bool:
@@ -265,6 +386,14 @@ class AlwaysLearningPipeline:
             "limit": self.monitor.limit(),
             "baseline": self.monitor.baseline,
         }
+        # The tripped alarm is a postmortem-grade incident BEFORE the
+        # demotion is attempted: the flight recorder snapshots the ring
+        # while the regressed checkpoint's serving history is still in
+        # it. The demotion itself shares the rollback's trace ID.
+        rollback_trace = new_trace_id()
+        get_tracer().incident(
+            "rollback_trip", trace_id=rollback_trace, **entry
+        )
         # Retract FIRST so a concurrently-polling coordinator cannot
         # re-promote the demoted step between the swap and the cleanup.
         # Deferred candidates above last-good lose their published files
@@ -273,7 +402,7 @@ class AlwaysLearningPipeline:
         # retracted, never-served checkpoint).
         self.promoter.retract_above(last_good.step)
         still_deferred = []
-        for verdict, promoted, path in self._deferred:
+        for verdict, promoted, path, tr in self._deferred:
             if verdict.step > last_good.step:
                 self.log.append(
                     "promotion_superseded",
@@ -281,12 +410,13 @@ class AlwaysLearningPipeline:
                     checkpoint=verdict.path,
                     reason=f"retracted by the rollback to step "
                     f"{last_good.step} while its swap was deferred",
+                    trace_id=tr.trace_id,
                 )
             else:
-                still_deferred.append((verdict, promoted, path))
+                still_deferred.append((verdict, promoted, path, tr))
         self._deferred = still_deferred
         if not self.coordinator.reload_pinned(
-            last_good.promoted, monotonic=False
+            last_good.promoted, monotonic=False, trace_id=rollback_trace
         ):
             # The demotion commit itself failed (wedged replica /
             # unreadable last-good): the regressed checkpoint is STILL
@@ -306,12 +436,13 @@ class AlwaysLearningPipeline:
                 **entry,
                 reason="pinned reload did not commit (see coordinator "
                 "load_errors); retrying on later polls",
+                trace_id=rollback_trace,
             )
             return False
         self.gate.rebase(last_good.step)
         self.monitor.reset()
         self.rollbacks.append(entry)
-        self.log.append("rolled_back", **entry)
+        self.log.append("rolled_back", **entry, trace_id=rollback_trace)
         return True
 
     def poll_once(self) -> int:
@@ -401,7 +532,21 @@ class AlwaysLearningPipeline:
             idx = min(len(latencies) - 1, int(q * len(latencies)))
             return round(latencies[idx], 4)
 
+        # Per-stage p50s over every traced promotion — the bench's
+        # promotion_span_breakdown (where did the promotion seconds go).
+        by_stage: Dict[str, List[float]] = {}
+        for r in self.promotions:
+            for stage, seconds in (r.spans or {}).items():
+                by_stage.setdefault(stage, []).append(seconds)
+        breakdown = {}
+        for stage, values in by_stage.items():
+            values.sort()
+            breakdown[stage] = round(
+                values[min(len(values) - 1, int(0.5 * len(values)))], 4
+            )
+
         return {
+            "promotion_span_breakdown": breakdown,
             "promotions": len(self.promotions),
             "rejections": len(self.rejections),
             "rollbacks": len(self.rollbacks),

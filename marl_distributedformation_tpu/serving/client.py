@@ -27,6 +27,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from marl_distributedformation_tpu.obs import new_trace_id
 from marl_distributedformation_tpu.serving.scheduler import (
     BackpressureError,
     ServedResult,
@@ -73,13 +74,14 @@ class ServingClient:
         obs: np.ndarray,
         deterministic: bool = True,
         timeout_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> Tuple[np.ndarray, int]:
         """Blocking predict; returns ``(actions, model_step)``.
 
         Raises ``RequestTimeout`` when the request's deadline passes,
         ``BackpressureError`` when the queue stayed full through every
         retry."""
-        result = self.predict_full(obs, deterministic, timeout_s)
+        result = self.predict_full(obs, deterministic, timeout_s, trace_id)
         return result.actions, result.model_step
 
     def predict_full(
@@ -87,16 +89,23 @@ class ServingClient:
         obs: np.ndarray,
         deterministic: bool = True,
         timeout_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> ServedResult:
         wait_s = (
             timeout_s
             if timeout_s is not None
             else self.scheduler.default_timeout_s
         )
+        # ONE trace ID for the whole logical request: minted here when
+        # the caller has none, re-sent on every backpressure retry, so
+        # the server-side batch spans of all attempts correlate to this
+        # single predict call (the whole point of retry observability).
+        trace_id = trace_id or new_trace_id()
         for attempt in range(self.max_retries + 1):
             try:
                 future = self.scheduler.submit(
-                    obs, deterministic=deterministic, timeout_s=timeout_s
+                    obs, deterministic=deterministic, timeout_s=timeout_s,
+                    trace_id=trace_id,
                 )
                 # Slack over the request's own deadline: the scheduler
                 # fails expired requests itself; this outer bound only

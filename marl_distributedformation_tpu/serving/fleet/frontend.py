@@ -24,7 +24,15 @@ Protocol (all bodies JSON):
   with the exception class name (no tracebacks over the wire).
 - ``GET /v1/health`` → ``200`` while any replica serves, ``503`` when
   none does (load-balancer shaped). ``GET /v1/metrics`` → the
-  aggregated fleet snapshot.
+  aggregated fleet snapshot — JSON by default, Prometheus text
+  exposition when the client asks for it (``Accept: text/plain`` or an
+  openmetrics type; ``obs/export.py``).
+- **Trace identity**: ``POST /v1/act`` accepts an ``X-Trace-Id`` header
+  (minting one when absent, sanitizing what arrives) and echoes it on
+  EVERY response — success and failure alike — both as the header and
+  as ``trace_id`` in the JSON body of errors (429/504/...), so a
+  client's retries stay correlatable across failover. The same ID rides
+  ``FleetRouter.submit`` down to the dispatch batch span (obs/).
 """
 
 from __future__ import annotations
@@ -42,6 +50,14 @@ from typing import Any, Optional
 
 import numpy as np
 
+from marl_distributedformation_tpu.obs import (
+    PROMETHEUS_CONTENT_TYPE,
+    TRACE_HEADER,
+    new_trace_id,
+    prometheus_exposition,
+    sanitize_trace_id,
+    wants_prometheus,
+)
 from marl_distributedformation_tpu.serving.fleet.router import (
     FleetRouter,
     NoHealthyReplicas,
@@ -68,11 +84,18 @@ def _make_handler(router: FleetRouter):
             status: int,
             payload: dict,
             retry_after_s: Optional[float] = None,
+            trace_id: Optional[str] = None,
         ) -> None:
+            # One seam for the 'every response correlates' contract:
+            # the ID rides both the header and the JSON body.
+            if trace_id is not None:
+                payload = {**payload, "trace_id": trace_id}
             body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if trace_id is not None:
+                self.send_header(TRACE_HEADER, trace_id)
             if retry_after_s is not None:
                 self.send_header(
                     "Retry-After", str(max(1, math.ceil(retry_after_s)))
@@ -82,6 +105,19 @@ def _make_handler(router: FleetRouter):
                 self.wfile.write(body)
             except (BrokenPipeError, ConnectionResetError):
                 pass  # client gave up; nothing to salvage
+
+        def _reply_text(
+            self, status: int, text: str, content_type: str
+        ) -> None:
+            body = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
 
         # -- reads -------------------------------------------------------
 
@@ -102,15 +138,35 @@ def _make_handler(router: FleetRouter):
                     },
                 )
             elif self.path == "/v1/metrics":
-                self._reply(200, router.snapshot())
+                snap = router.snapshot()
+                if wants_prometheus(self.headers.get("Accept")):
+                    self._reply_text(
+                        200,
+                        prometheus_exposition(snap),
+                        PROMETHEUS_CONTENT_TYPE,
+                    )
+                else:
+                    self._reply(200, snap)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         # -- act ---------------------------------------------------------
 
         def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+            # Trace identity first: accepted from the client (sanitized)
+            # or minted here, echoed on EVERY response below — success,
+            # backpressure, timeout — and carried through the router so
+            # the dispatch batch span links back to this request.
+            trace_id = (
+                sanitize_trace_id(self.headers.get(TRACE_HEADER))
+                or new_trace_id()
+            )
             if self.path != "/v1/act":
-                self._reply(404, {"error": f"unknown path {self.path}"})
+                self._reply(
+                    404,
+                    {"error": f"unknown path {self.path}"},
+                    trace_id=trace_id,
+                )
                 return
             try:
                 length = int(self.headers.get("Content-Length", 0))
@@ -125,11 +181,16 @@ def _make_handler(router: FleetRouter):
                 if timeout_s is not None:
                     timeout_s = float(timeout_s)
             except (ValueError, KeyError, TypeError) as e:
-                self._reply(400, {"error": f"bad request: {e}"})
+                self._reply(
+                    400,
+                    {"error": f"bad request: {e}"},
+                    trace_id=trace_id,
+                )
                 return
             try:
                 future = router.submit(
-                    obs, deterministic=deterministic, timeout_s=timeout_s
+                    obs, deterministic=deterministic, timeout_s=timeout_s,
+                    trace_id=trace_id,
                 )
                 wait = (
                     timeout_s
@@ -148,17 +209,38 @@ def _make_handler(router: FleetRouter):
                         "retry_after_s": e.retry_after_s,
                     },
                     retry_after_s=e.retry_after_s,
+                    trace_id=trace_id,
                 )
             except NoHealthyReplicas as e:
-                self._reply(503, {"error": str(e)})
+                self._reply(
+                    503,
+                    {"error": str(e)},
+                    trace_id=trace_id,
+                )
             except (RequestTimeout, TimeoutError, FutureTimeoutError) as e:
-                self._reply(504, {"error": f"deadline passed: {e}"})
+                self._reply(
+                    504,
+                    {"error": f"deadline passed: {e}"},
+                    trace_id=trace_id,
+                )
             except SchedulerStopped as e:
-                self._reply(503, {"error": str(e)})
+                self._reply(
+                    503,
+                    {"error": str(e)},
+                    trace_id=trace_id,
+                )
             except ValueError as e:
-                self._reply(400, {"error": f"bad request: {e}"})
+                self._reply(
+                    400,
+                    {"error": f"bad request: {e}"},
+                    trace_id=trace_id,
+                )
             except Exception as e:  # noqa: BLE001 — no tracebacks on the wire
-                self._reply(500, {"error": type(e).__name__})
+                self._reply(
+                    500,
+                    {"error": type(e).__name__},
+                    trace_id=trace_id,
+                )
             else:
                 self._reply(
                     200,
@@ -168,6 +250,7 @@ def _make_handler(router: FleetRouter):
                         "replica": int(result.replica),
                         "latency_s": round(result.latency_s, 6),
                     },
+                    trace_id=trace_id,
                 )
 
     return _Handler

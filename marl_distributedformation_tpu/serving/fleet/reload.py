@@ -40,10 +40,12 @@ stale one.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from pathlib import Path
 from typing import Any, Deque, Optional, Tuple
 
+from marl_distributedformation_tpu.obs import get_tracer
 from marl_distributedformation_tpu.utils.checkpoint import (
     CheckpointDiscovery,
     checkpoint_step,
@@ -183,10 +185,12 @@ class FleetReloadCoordinator:
 
     # -- reload ---------------------------------------------------------
 
-    def refresh(self) -> bool:
+    def refresh(self, trace_id: Optional[str] = None) -> bool:
         """Check the directory once; coordinated-swap if a newer
         checkpoint landed. Returns True on swap. Load failures keep the
-        old params serving fleet-wide and are recorded."""
+        old params serving fleet-wide and are recorded. ``trace_id``
+        labels the commit's spans (the pipeline passes its candidate's
+        ID so one trace reconstructs the whole promotion)."""
         with self._refresh_lock:
             path = self._discovery.latest()
             if path is None:
@@ -194,9 +198,14 @@ class FleetReloadCoordinator:
             step = checkpoint_step(path)
             if step <= self._fleet_step:
                 return False
-            return self._load_and_commit(path, step)
+            return self._load_and_commit(path, step, trace_id)
 
-    def reload_pinned(self, path: str | Path, monotonic: bool = True) -> bool:
+    def reload_pinned(
+        self,
+        path: str | Path,
+        monotonic: bool = True,
+        trace_id: Optional[str] = None,
+    ) -> bool:
         """Coordinated swap of an EXPLICIT checkpoint path, bypassing
         directory discovery. ``monotonic=False`` is the DEMOTION hook
         (pipeline/rollback): the swap is exempt from the never-go-
@@ -220,13 +229,19 @@ class FleetReloadCoordinator:
                 return False
             if step == self._fleet_step:
                 return False  # already serving exactly this step
-            return self._load_and_commit(path, step)
+            return self._load_and_commit(path, step, trace_id)
 
-    def _load_and_commit(self, path: Path, step: int) -> bool:
+    def _load_and_commit(
+        self, path: Path, step: int, trace_id: Optional[str] = None
+    ) -> bool:
         """Restore + validate once, then commit fleet-wide at the batch
         barrier. Caller holds ``_refresh_lock``."""
+        tracer = get_tracer()
         try:
-            restored = self._load_validated(path)
+            with tracer.span(
+                "reload.load", trace_id=trace_id, step=step, path=str(path)
+            ):
+                restored = self._load_validated(path)
         except Exception as e:  # noqa: BLE001 — serving must not die
             self.load_errors.append((str(path), repr(e)))
             return False
@@ -235,12 +250,14 @@ class FleetReloadCoordinator:
         # Prepare: one host->device upload per replica, all before
         # the barrier — the commit window stays lock-acquisition
         # plus pointer flips, never a weight transfer.
-        staged = [
-            (r, jax.device_put(restored, r.registry.device))
-            for r in self.router.replicas
-        ]
+        with tracer.span("reload.stage", trace_id=trace_id, step=step):
+            staged = [
+                (r, jax.device_put(restored, r.registry.device))
+                for r in self.router.replicas
+            ]
         barriers = [r.registry.batch_lock for r, _ in staged]
         held = []
+        wedged_replica = None
         try:
             # Close every gate FIRST: workers finish their current
             # batch and park instead of re-contending their lock, so
@@ -256,7 +273,17 @@ class FleetReloadCoordinator:
             for b in barriers:
                 b.close()
             for i, b in enumerate(barriers):
-                if not b.acquire(timeout=self.commit_timeout_s):
+                t_acq = time.perf_counter()
+                acquired = b.acquire(timeout=self.commit_timeout_s)
+                tracer.add_span(
+                    "reload.barrier_acquire",
+                    t_acq,
+                    time.perf_counter(),
+                    trace_id=trace_id,
+                    replica=i,
+                    acquired=acquired,
+                )
+                if not acquired:
                     self.load_errors.append(
                         (
                             str(path),
@@ -266,17 +293,35 @@ class FleetReloadCoordinator:
                             "serving fleet-wide",
                         )
                     )
+                    wedged_replica = i
                     return False
                 held.append(b)
-            for r, params in staged:
-                r.registry.install(params, step)
-            self._fleet_step = step
-            self.swap_count += 1
+            with tracer.span(
+                "reload.commit", trace_id=trace_id, step=step,
+                replicas=len(staged),
+            ):
+                for r, params in staged:
+                    r.registry.install(params, step)
+                self._fleet_step = step
+                self.swap_count += 1
         finally:
             for b in reversed(held):
                 b.release()
             for b in barriers:
                 b.open()
+            if wedged_replica is not None:
+                # A wedged barrier is a postmortem-grade incident: the
+                # ring still holds the dispatches that led here. Dumped
+                # AFTER the gates reopen — the flight-recorder file
+                # write must not extend the fleet-wide serving pause.
+                tracer.incident(
+                    "wedged_barrier_abort",
+                    trace_id=trace_id,
+                    replica=wedged_replica,
+                    step=step,
+                    path=str(path),
+                    commit_timeout_s=self.commit_timeout_s,
+                )
         return True
 
     def _load_validated(self, path: Path) -> Any:

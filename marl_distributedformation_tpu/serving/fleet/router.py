@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from marl_distributedformation_tpu.analysis.guards import RetraceError
+from marl_distributedformation_tpu.obs import get_tracer
 from marl_distributedformation_tpu.serving.engine import (
     DEFAULT_BUCKETS,
     BucketedPolicyEngine,
@@ -194,6 +195,7 @@ class FleetRouter:
         deterministic: bool = True,
         timeout_s: Optional[float] = None,
         on_result: Optional[Any] = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Route one request; returns a future resolving to
         ``ServedResult`` (with ``.replica`` set). Raises
@@ -213,11 +215,13 @@ class FleetRouter:
         )
         deadline = time.perf_counter() + timeout
         outer: Future = Future()
-        replica, inner = self._route(obs, deterministic, timeout_s, set())
+        replica, inner = self._route(
+            obs, deterministic, timeout_s, set(), trace_id
+        )
         self._chain(
             replica, inner, outer, obs, deterministic, timeout_s,
             hops=0, tried={replica.index}, deadline=deadline,
-            on_result=on_result,
+            on_result=on_result, trace_id=trace_id,
         )
         return outer
 
@@ -229,6 +233,7 @@ class FleetRouter:
         deterministic: bool,
         timeout_s: Optional[float],
         tried: Set[int],
+        trace_id: Optional[str] = None,
     ) -> Tuple[Replica, Future]:
         """Submit to the best healthy replica not in ``tried``; walk down
         the drain-time ordering past individually-full replicas."""
@@ -248,7 +253,8 @@ class FleetRouter:
                 continue
             try:
                 inner = r.scheduler.submit(
-                    obs, deterministic=deterministic, timeout_s=timeout_s
+                    obs, deterministic=deterministic, timeout_s=timeout_s,
+                    trace_id=trace_id,
                 )
                 return r, inner
             except BackpressureError as e:
@@ -284,6 +290,7 @@ class FleetRouter:
         tried: Set[int],
         deadline: float,
         on_result: Optional[Any] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Resolve ``outer`` from ``inner``, failing over replica faults
         onto a fresh replica while the hop budget and deadline allow."""
@@ -322,7 +329,7 @@ class FleetRouter:
                 ):
                     try:
                         nxt, nfut = self._route(
-                            obs, deterministic, timeout_s, tried
+                            obs, deterministic, timeout_s, tried, trace_id
                         )
                     except Exception as routing_exc:  # noqa: BLE001
                         outer.set_exception(routing_exc)
@@ -331,7 +338,7 @@ class FleetRouter:
                     self._chain(
                         nxt, nfut, outer, obs, deterministic, timeout_s,
                         hops + 1, tried | {nxt.index}, deadline,
-                        on_result=on_result,
+                        on_result=on_result, trace_id=trace_id,
                     )
                     return
             outer.set_exception(exc)
@@ -354,6 +361,15 @@ class FleetRouter:
             replica.broken_at = time.monotonic()
             replica.break_reason = reason
         self.metrics.record_break()
+        # Circuit break = an incident: snapshot the trace ring while the
+        # pre-break dispatch history is still in it (flight recorder,
+        # when configured) — outside the health lock, it does file IO.
+        get_tracer().incident(
+            "circuit_break",
+            replica=replica.index,
+            reason=reason,
+            healthy_replicas=self.healthy_replicas,
+        )
 
     def _probe_broken(self) -> None:
         """Half-open probing on the routing path: a broken replica whose

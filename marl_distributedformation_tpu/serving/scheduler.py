@@ -39,6 +39,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from marl_distributedformation_tpu.obs import get_tracer
 from marl_distributedformation_tpu.serving.engine import BucketedPolicyEngine
 from marl_distributedformation_tpu.serving.metrics import ServingMetrics
 
@@ -78,6 +79,7 @@ class _Request:
     future: Future
     enqueued: float
     timeout_s: Optional[float]
+    trace_id: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.timeout_s is not None and (
@@ -130,10 +132,13 @@ class MicroBatchScheduler:
         obs: np.ndarray,
         deterministic: bool = True,
         timeout_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Enqueue one request of ``(n, *row_shape)`` observation rows.
         Returns a future resolving to :class:`ServedResult`. Raises
-        :class:`BackpressureError` when the queue is full."""
+        :class:`BackpressureError` when the queue is full. ``trace_id``
+        rides the request to the dispatch batch span (obs/) so one ID
+        correlates a request across frontend, router, and batch."""
         if self._thread is None:
             raise RuntimeError("scheduler not started (use start() / with)")
         obs = np.asarray(obs, np.float32)
@@ -149,6 +154,7 @@ class MicroBatchScheduler:
             timeout_s=(
                 self.default_timeout_s if timeout_s is None else timeout_s
             ),
+            trace_id=trace_id,
         )
         try:
             self._queue.put_nowait(req)
@@ -229,6 +235,22 @@ class MicroBatchScheduler:
     # -- worker side -----------------------------------------------------
 
     def _run(self) -> None:
+        try:
+            self._serve_loop()
+        except BaseException as e:
+            # The per-batch backstop in _serve_loop contains dispatch
+            # errors; anything escaping to here kills the worker thread
+            # outright — every queued future wedges until the router's
+            # liveness probe notices. Snapshot the ring for the
+            # postmortem before dying.
+            get_tracer().incident(
+                "scheduler_worker_death",
+                error=repr(e),
+                queue_depth=self._queue.qsize(),
+            )
+            raise
+
+    def _serve_loop(self) -> None:
         while not self._stop.is_set():
             try:
                 first = self._queue.get(timeout=0.05)
@@ -318,6 +340,21 @@ class MicroBatchScheduler:
                 req.future.set_exception(e)
             return
         done = time.perf_counter()
+        tracer = get_tracer()
+        if tracer.enabled:
+            # The batch span LINKS the coalesced requests' trace IDs: a
+            # request traced at the frontend is findable inside the
+            # dispatch that actually served it. One ring append per
+            # batch — host-side, after the engine returned.
+            tracer.add_span(
+                "serve.batch",
+                t0,
+                done,
+                rows=sum(sizes),
+                requests=len(group),
+                model_step=int(step),
+                trace_ids=[r.trace_id for r in group if r.trace_id],
+            )
         latencies = []
         offset = 0
         for req, n in zip(group, sizes):

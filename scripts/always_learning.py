@@ -70,6 +70,10 @@ PIPELINE_KEYS = (
     "rollback_direction",
     "rollback_trip_after",
     "rollback_baseline_samples",
+    # observability spine (obs/, docs/observability.md)
+    "obs_trace",
+    "obs_ring_size",
+    "obs_flightrec",
     "out",
 )
 # Trainer knobs are the normal YAML config surface (train.py is
@@ -151,6 +155,23 @@ def main(argv=None) -> dict:
             "population sweeps / curriculum trainers checkpoint a "
             "different layout (drop num_seeds / curriculum)"
         )
+
+    # Observability spine (obs/): the tracer records promotion spans +
+    # serving batch spans into per-thread rings, and the flight recorder
+    # snapshots them next to the checkpoints on incidents (circuit
+    # break, rollback trip, wedged barrier). Knobs in cfg/config.yaml.
+    from marl_distributedformation_tpu import obs as obs_spine
+
+    obs_enabled = bool(cfg.get("obs_trace", True))
+    obs_spine.configure(
+        enabled=obs_enabled,
+        ring_size=int(cfg.get("obs_ring_size", 4096)),
+        flightrec_dir=(
+            str(trainer.log_dir)
+            if cfg.get("obs_flightrec", True)
+            else ""
+        ),
+    )
 
     budget_s = float(cfg.get("pipeline_budget_s", 600.0))
     deadline = time.time() + budget_s
@@ -274,6 +295,18 @@ def main(argv=None) -> dict:
         if router is not None:
             router.stop()
         pipeline.stop()
+
+    if obs_enabled:
+        # Leave the whole run's spans beside promotions.jsonl —
+        # scripts/trace_report.py renders them Perfetto-loadable.
+        try:
+            report["trace_dump"] = str(
+                obs_spine.get_tracer().dump(
+                    Path(trainer.log_dir) / "trace_spans.json"
+                )
+            )
+        except OSError:
+            pass
 
     out = cfg.get("out")
     if out:

@@ -20,6 +20,7 @@ wrapper), so the gate and the mirror can never disagree on a file.
 from __future__ import annotations
 
 import argparse
+import math
 import sys
 from pathlib import Path
 
@@ -79,6 +80,71 @@ def _pipeline_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _obs_problems(rec: dict) -> list[str]:
+    """Structural validation of the obs tracing fields (bench phase 8):
+    a tracing overhead that is not a finite number, or a promotion span
+    breakdown whose stages overshoot the latency they decompose, is a
+    malformed record."""
+    problems = []
+    pct = rec.get("tracing_overhead_pct")
+    if pct is not None:
+        try:
+            if not math.isfinite(float(pct)):
+                problems.append(
+                    f"tracing_overhead_pct not finite: {pct!r}"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"tracing_overhead_pct is not a number: {pct!r}"
+            )
+    breakdown = rec.get("promotion_span_breakdown")
+    if breakdown is not None:
+        if not isinstance(breakdown, dict) or not breakdown:
+            problems.append(
+                f"promotion_span_breakdown must be a non-empty dict of "
+                f"stage->seconds: {breakdown!r}"
+            )
+            return problems
+        try:
+            stages = {str(k): float(v) for k, v in breakdown.items()}
+        except (TypeError, ValueError):
+            problems.append(
+                f"promotion_span_breakdown has non-numeric stages: "
+                f"{breakdown!r}"
+            )
+            return problems
+        bad = {k: v for k, v in stages.items() if v < 0.0}
+        if bad:
+            problems.append(
+                f"promotion_span_breakdown stages negative: {bad!r}"
+            )
+        # The stage p50s decompose the promotion latency: their sum may
+        # not exceed the recorded p95 by more than clock-noise tolerance
+        # (stages summing PAST the latency they claim to explain means
+        # the decomposition double-counts). deferred_wait_s is excluded:
+        # it exists only on deferred promotions, so its p50 conditions
+        # on a different promotion subset than the latency percentile —
+        # a handful of long defers among many fast promotions would push
+        # the sum past a p95 that legitimately never saw them.
+        p95 = rec.get("promotion_latency_s_p95")
+        try:
+            p95 = float(p95) if p95 is not None else None
+        except (TypeError, ValueError):
+            p95 = None  # already reported by _pipeline_problems
+        if p95 is not None:
+            total = sum(
+                v for k, v in stages.items() if k != "deferred_wait_s"
+            )
+            tolerance = max(0.5, 0.1 * p95)
+            if total > p95 + tolerance:
+                problems.append(
+                    f"promotion_span_breakdown sums to {total:.3f}s, "
+                    f"exceeding promotion_latency_s_p95={p95:.3f}s "
+                    f"+ tolerance {tolerance:.3f}s"
+                )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -92,6 +158,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     if "skipped" in notes or "failed" in notes:
         problems.append(f"degraded phases in notes: {notes!r}")
     problems.extend(_pipeline_problems(rec))
+    problems.extend(_obs_problems(rec))
     for field in require:
         try:
             ok = float(rec.get(field, 0.0)) > 0.0

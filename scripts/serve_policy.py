@@ -346,7 +346,19 @@ def main(argv=None) -> int:
         help="with --fleet: expose the stdlib HTTP frontend on this "
         "port (0 = ephemeral; the bound port is printed to stderr)",
     )
+    parser.add_argument(
+        "--obs-trace",
+        choices=("on", "off"),
+        default="on",
+        help="obs/ tracing spine: batch spans + trace-ID propagation "
+        "(default on; bench phase 8 runs the smoke both ways to measure "
+        "the overhead)",
+    )
     args = parser.parse_args(argv)
+
+    from marl_distributedformation_tpu import obs
+
+    obs.configure(enabled=args.obs_trace == "on")
 
     if (args.port is not None or args.replicas is not None) and not args.fleet:
         raise SystemExit("--port/--replicas require --fleet")

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Render a run's obs spans into a Perfetto-loadable Chrome trace file.
+
+Input is any span dump the obs spine writes:
+
+- ``Tracer.dump()`` output (``trace_spans.json`` — what
+  ``scripts/always_learning.py`` leaves beside ``promotions.jsonl``),
+- a flight-recorder snapshot (``flightrec-*.json``),
+- or a bare JSON list of snapshot records.
+
+Output is Chrome trace-event JSON (``--out``, default
+``<input>.chrome.json``): one lane per recording thread, spans as
+complete events, instants for events, trace IDs in ``args`` so
+Perfetto's search finds every leg of one promotion or request by its
+ID. Load it at https://ui.perfetto.dev or ``chrome://tracing`` — and
+because timestamps are epoch microseconds it merges cleanly alongside
+``TraceWindow``'s XLA captures from the same run.
+
+    python scripts/trace_report.py logs/always/trace_spans.json
+    python scripts/trace_report.py logs/always/flightrec-rollback_trip-0001.json \\
+        --out /tmp/rollback.chrome.json
+
+``--trace-id`` filters to one trace's records (plus unlabelled spans
+with ``--keep-unlabelled``), which is how you pull a single promotion's
+lane out of a long run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from marl_distributedformation_tpu.obs import chrome_trace  # noqa: E402
+
+
+def load_records(path: Path) -> list:
+    """Snapshot records from any of the obs dump shapes."""
+    payload = json.loads(path.read_text())
+    if isinstance(payload, list):
+        return payload
+    if isinstance(payload, dict) and isinstance(
+        payload.get("records"), list
+    ):
+        return payload["records"]
+    raise SystemExit(
+        f"{path} is not an obs span dump (expected a Tracer.dump / "
+        "flightrec JSON with a 'records' list, or a bare record list)"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("input", type=Path, help="span dump to render")
+    ap.add_argument(
+        "--out",
+        type=Path,
+        help="output Chrome trace path (default <input>.chrome.json)",
+    )
+    ap.add_argument(
+        "--trace-id",
+        help="keep only records labelled with this trace ID",
+    )
+    ap.add_argument(
+        "--keep-unlabelled",
+        action="store_true",
+        help="with --trace-id: also keep records carrying no trace ID",
+    )
+    args = ap.parse_args(argv)
+
+    records = load_records(args.input)
+    total = len(records)
+    if args.trace_id:
+        records = [
+            r
+            for r in records
+            if r.get("trace_id") == args.trace_id
+            or (args.keep_unlabelled and not r.get("trace_id"))
+        ]
+    out = args.out or args.input.with_suffix(".chrome.json")
+    trace = chrome_trace(records, process_name=args.input.stem)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(trace))
+    lanes = {
+        e["tid"] for e in trace["traceEvents"] if e.get("ph") == "X"
+    }
+    print(
+        f"[trace_report] {len(records)}/{total} records -> {out} "
+        f"({len(lanes)} lane(s)); load at https://ui.perfetto.dev",
+        file=sys.stderr,
+    )
+    print(str(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -191,6 +191,51 @@ def test_check_bench_record_gates():
     assert check(clean, ["train_env_steps_per_sec"], [])  # absent field
     assert check({**clean, "value": 0.0}, ["value"], [])  # zero rate
     assert check(clean, [], ["knn_impl=xla"])  # impl mismatch
+    # Obs tracing fields (bench phase 8), validated whenever present:
+    # overhead must be a finite number; the span breakdown must be a
+    # numeric stage dict whose sum stays within the latency + tolerance.
+    assert check({**clean, "tracing_overhead_pct": 1.7}, [], []) == []
+    assert check({**clean, "tracing_overhead_pct": -0.4}, [], []) == []
+    assert check({**clean, "tracing_overhead_pct": float("inf")}, [], [])
+    assert check({**clean, "tracing_overhead_pct": "fast"}, [], [])
+    pipeline_ok = {
+        **clean,
+        "promotion_latency_s_p50": 2.0, "promotion_latency_s_p95": 3.0,
+        "gate_eval_steps_per_sec": 100.0, "pipeline_gate_compiles": 1,
+    }
+    breakdown = {
+        "stream_poll_s": 1.0, "gate_eval_s": 0.8, "publish_s": 0.01,
+        "barrier_commit_s": 0.15, "first_serve_s": 0.04,
+    }
+    assert check(
+        {**pipeline_ok, "promotion_span_breakdown": breakdown}, [], []
+    ) == []
+    assert check(  # stages sum past p95 + tolerance: double counting
+        {**pipeline_ok,
+         "promotion_span_breakdown": {**breakdown, "stream_poll_s": 9.0}},
+        [], [],
+    )
+    # deferred_wait_s is p50'd over ONLY deferred promotions — a few
+    # long defers among many fast promotions may dwarf the all-promotion
+    # latency p95 on a healthy run, so it stays out of the sum check.
+    assert check(
+        {**pipeline_ok,
+         "promotion_span_breakdown": {**breakdown, "deferred_wait_s": 30.0}},
+        [], [],
+    ) == []
+    assert check(
+        {**pipeline_ok, "promotion_span_breakdown": {}}, [], []
+    )
+    assert check(
+        {**pipeline_ok,
+         "promotion_span_breakdown": {"gate_eval_s": "slow"}},
+        [], [],
+    )
+    assert check(
+        {**pipeline_ok,
+         "promotion_span_breakdown": {"gate_eval_s": -1.0}},
+        [], [],
+    )
 
 
 def test_partial_mirror_names_dodge_replay_glob():

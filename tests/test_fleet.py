@@ -444,6 +444,11 @@ def test_frontend_maps_failure_taxonomy_to_status_codes():
             assert payload["error"] == "backpressure"
             assert payload["retry_after_s"] > 0.0
             assert int(e.headers["Retry-After"]) >= 1
+            # Error bodies are correlatable: the 429 carries the trace
+            # ID (minted server-side here — no header was sent) in both
+            # the body and the echoed header.
+            assert payload["trace_id"]
+            assert e.headers["X-Trace-Id"] == payload["trace_id"]
         for fut in (in_flight, queued):
             assert fut.result(timeout=30).actions.shape == (1, 2)
         # Whole fleet broken -> health 503 and act 503.
@@ -496,3 +501,100 @@ def test_frontend_concurrent_clients_consistent_answers():
             t.join(timeout=60)
     assert not errors, errors
     assert replicas_seen <= {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# Trace-ID propagation (obs/): frontend -> router -> scheduler batch span
+# ---------------------------------------------------------------------------
+
+
+def _post_traced(url, payload, trace_id=None, timeout=30):
+    """POST /v1/act returning (body, echoed X-Trace-Id header)."""
+    headers = {"Content-Type": "application/json"}
+    if trace_id is not None:
+        headers["X-Trace-Id"] = trace_id
+    req = urllib.request.Request(
+        url + "/v1/act",
+        data=json.dumps(payload).encode(),
+        headers=headers,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read()), resp.headers.get("X-Trace-Id")
+
+
+def test_trace_id_propagates_frontend_to_batch_span():
+    """ONE ID correlates a request across every layer: the header a
+    client sends comes back on its own response (concurrent requests
+    keep DISTINCT ids — no cross-talk through the coalescing batcher),
+    a header-less request gets a minted ID, and the scheduler's
+    ``serve.batch`` spans link the coalesced requests' trace IDs so the
+    dispatch that served a request is findable by its ID."""
+    from marl_distributedformation_tpu.obs import Tracer, set_tracer
+
+    tracer = Tracer(ring_size=1024)
+    previous = set_tracer(tracer)
+    try:
+        policy = _make_policy()
+        router = FleetRouter(policy, num_replicas=2, buckets=(1, 8))
+        warmup_fleet(router, (OBS_DIM,))
+        sent_ids = [f"client-req-{i}" for i in range(8)]
+        echoes = {}
+        errors = []
+
+        def worker(tid):
+            try:
+                body, header = _post_traced(
+                    frontend.url, {"obs": _obs(2, seed=1).tolist()},
+                    trace_id=tid,
+                )
+                echoes[tid] = (body["trace_id"], header)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        with router, FleetFrontend(router, port=0) as frontend:
+            threads = [
+                threading.Thread(target=worker, args=(tid,), daemon=True)
+                for tid in sent_ids
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+            # Every concurrent request got ITS OWN id back, body+header.
+            assert echoes == {tid: (tid, tid) for tid in sent_ids}
+            # No header -> the frontend mints one and still echoes it.
+            body, header = _post_traced(
+                frontend.url, {"obs": _obs(1, seed=2).tolist()}
+            )
+            assert body["trace_id"] and header == body["trace_id"]
+            assert body["trace_id"] not in sent_ids
+            # An unusable header is re-minted, not parroted back.
+            weird, _ = _post_traced(
+                frontend.url, {"obs": _obs(1, seed=3).tolist()},
+                trace_id='evil"id',
+            )
+            assert weird["trace_id"] != 'evil"id'
+        # The batch spans LINK the request ids: every sent id appears in
+        # some dispatch's linked set, and ids never bleed into spans
+        # that did not serve them more than once each.
+        batch_spans = [
+            r
+            for r in tracer.snapshot()
+            if r["kind"] == "span" and r["name"] == "serve.batch"
+        ]
+        assert batch_spans, "no serve.batch spans recorded"
+        linked = [
+            tid
+            for span in batch_spans
+            for tid in span["attrs"].get("trace_ids", ())
+        ]
+        assert set(sent_ids) <= set(linked)
+        for tid in sent_ids:
+            assert linked.count(tid) == 1, f"{tid} served twice?"
+        # And batch spans carry the dispatch facts a timeline needs.
+        for span in batch_spans:
+            assert span["attrs"]["rows"] >= 1
+            assert span["attrs"]["model_step"] == 0
+    finally:
+        set_tracer(previous)

@@ -721,6 +721,71 @@ FIXTURES = [
             """,
         },
     ),
+    (
+        # Host-side tracing recorded INSIDE a jitted function: the span
+        # closes at trace time, measuring one compile and zero
+        # executions — and host work has leaked into the compiled scope.
+        "span-in-traced-scope",
+        """
+        import jax
+        from marl_distributedformation_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+
+        @jax.jit
+        def step(x):
+            with tracer.span("step"):
+                return x * 2
+        """,
+        """
+        import jax
+        from marl_distributedformation_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def dispatch(x):
+            # the dispatch seam: span wraps the jitted CALL, host-side
+            with tracer.span("step"):
+                return step(x)
+        """,
+    ),
+    (
+        # Same hazard one hop away inside a scan body: the helper's
+        # event() call would record per trace, not per iteration — and
+        # via get_tracer() it is invisible to a receiver-name check.
+        "span-in-traced-scope",
+        """
+        import jax
+        from jax import lax
+        from marl_distributedformation_tpu.obs import get_tracer
+
+        def note(x):
+            get_tracer().event("iteration", value=0)
+
+        def train(xs):
+            def body(carry, x):
+                note(x)
+                return carry + x, x
+            return lax.scan(body, 0.0, xs)
+        """,
+        """
+        import jax
+        from jax import lax
+        from marl_distributedformation_tpu.obs import get_tracer
+
+        def train(xs):
+            def body(carry, x):
+                return carry + x, x
+            with get_tracer().span("train.chunk"):
+                carry, stacked = lax.scan(body, 0.0, xs)
+            get_tracer().event("chunk_done")
+            return carry, stacked
+        """,
+    ),
 ]
 
 
@@ -784,6 +849,25 @@ def test_package_scan_covers_train_modules():
     scenarios = {f.name for f in files if "scenarios" in f.parts}
     assert "schedule.py" in scenarios, (
         f"scenarios/schedule.py missing from the scan: {scenarios}"
+    )
+
+
+def test_package_scan_covers_obs_instrumented_seams():
+    """The zero-violation pin must include the tracing spine and the
+    subsystems it instruments — rule 15 (span-in-traced-scope) only
+    protects the budget-1 receipts if the files recording spans are in
+    the scan."""
+    from marl_distributedformation_tpu.analysis import load_config
+    from marl_distributedformation_tpu.analysis.linter import iter_python_files
+
+    files = list(iter_python_files([PACKAGE], load_config(REPO), root=REPO))
+    obs = {f.name for f in files if "obs" in f.parts}
+    assert {"tracer.py", "export.py", "flightrec.py"} <= obs, (
+        f"obs/ missing from the lint scan: {obs}"
+    )
+    pipeline = {f.name for f in files if "pipeline" in f.parts}
+    assert {"gate.py", "supervisor.py"} <= pipeline, (
+        f"pipeline/ missing from the lint scan: {pipeline}"
     )
 
 

@@ -55,6 +55,26 @@ from marl_distributedformation_tpu.utils.checkpoint import (
 ENV = EnvParams(num_agents=3, max_steps=20)
 
 
+@pytest.fixture
+def private_tracer(tmp_path):
+    """A test-private obs tracer with a flight recorder, installed as
+    the process-global one for the duration of the test (the pipeline's
+    seams resolve get_tracer() at call time)."""
+    from marl_distributedformation_tpu.obs import (
+        FlightRecorder,
+        Tracer,
+        set_tracer,
+    )
+
+    tracer = Tracer(
+        ring_size=4096,
+        flightrec=FlightRecorder(tmp_path / "flightrec", last_n=256),
+    )
+    previous = set_tracer(tracer)
+    yield tracer
+    set_tracer(previous)
+
+
 # ---------------------------------------------------------------------------
 # Incremental discovery (utils.checkpoint.CheckpointDiscovery)
 # ---------------------------------------------------------------------------
@@ -212,6 +232,33 @@ def test_promotion_log_schema(tmp_path):
     assert all(json.loads(ln) for ln in lines)
 
 
+def test_promotion_log_reader_accepts_schema_1_rejects_unknown(tmp_path):
+    """Schema bump 1 -> 2 (trace_id + spans): old logs stay readable —
+    the reader backfills the obs fields as None so schema-2 consumers
+    need no per-line branching — and an UNKNOWN (future) schema fails
+    loudly instead of being silently misread."""
+    assert PROMOTIONS_SCHEMA == 2
+    path = tmp_path / "promotions.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({  # a verbatim PR-7-era line
+            "schema": 1, "event": "promoted", "time": 1.0, "step": 10,
+            "checkpoint": "rl_model_10_steps.msgpack",
+        }) + "\n")
+    PromotionLog(path).append(
+        "promoted", step=20, trace_id="abc123", spans={"gate_eval_s": 0.5}
+    )
+    old, new = PromotionLog.read(path)
+    assert old["schema"] == 1
+    assert old["trace_id"] is None and old["spans"] is None
+    assert new["schema"] == 2
+    assert new["trace_id"] == "abc123"
+    assert new["spans"] == {"gate_eval_s": 0.5}
+    with open(path, "a") as f:
+        f.write(json.dumps({"schema": 99, "event": "promoted"}) + "\n")
+    with pytest.raises(ValueError, match="schema 99"):
+        PromotionLog.read(path)
+
+
 # ---------------------------------------------------------------------------
 # Rollback monitor
 # ---------------------------------------------------------------------------
@@ -337,7 +384,7 @@ def test_reload_pinned_demotes_backward(tmp_path):
         assert not coordinator.reload_pinned(ckpts[0], monotonic=False)
 
 
-def test_deferred_promotion_and_failed_rollback(tmp_path):
+def test_deferred_promotion_and_failed_rollback(tmp_path, private_tracer):
     """A wedged replica aborts the batch-barrier commit: a passing
     candidate must be DEFERRED (never logged 'promoted', never the gate
     baseline) until the commit lands, and a tripped rollback whose
@@ -387,6 +434,18 @@ def test_deferred_promotion_and_failed_rollback(tmp_path):
         ]
         assert events.count("promotion_deferred") == 1
         assert events.count("promoted") == 1  # only s1
+        # The wedged barrier was a postmortem-grade incident: the flight
+        # recorder dumped the ring the moment the commit aborted, with
+        # the deferred candidate's trace on the snapshot.
+        wedge_dumps = [
+            p
+            for p in private_tracer.flightrec.dumps()
+            if "wedged_barrier_abort" in p.name
+        ]
+        assert len(wedge_dumps) == 1
+        payload = json.loads(wedge_dumps[0].read_text())
+        assert payload["context"]["step"] == s2
+        assert payload["trace_id"]
         # Barrier clear -> the next poll retries and the commit lands.
         pipeline.poll_once()
         assert [r.step for r in pipeline.promotions] == [s1, s2]
@@ -515,7 +574,7 @@ def test_gate_rebase_survives_evicted_history():
 # ---------------------------------------------------------------------------
 
 
-def test_pipeline_end_to_end(tmp_path):
+def test_pipeline_end_to_end(tmp_path, private_tracer):
     assert len(jax.local_devices()) >= 2  # the conftest mesh
 
     log_dir = tmp_path / "run"
@@ -635,3 +694,83 @@ def test_pipeline_end_to_end(tmp_path):
     assert summary["promotions"] == len(pipeline.promotions)
     assert summary["rollbacks"] == 1
     assert summary["gate_eval_steps_per_sec"] > 0
+
+    # --- The obs spine (ISSUE 8 acceptance): ONE trace reconstructs a
+    # promotion end to end, and its span decomposition sums to the
+    # recorded promotion_latency_s within 10%. ---
+    promoted_recs = [r for r in records if r["event"] == "promoted"]
+    trace_ids = [r["trace_id"] for r in records if r["event"] in
+                 ("promoted", "rejected")]
+    assert all(trace_ids)
+    assert len(set(trace_ids)) == len(trace_ids)  # one trace PER candidate
+    post_fleet = [
+        r for r in promoted_recs
+        if r.get("promotion_latency_s") is not None
+    ]
+    assert post_fleet, "no promotion measured against a live fleet"
+    for r in post_fleet:
+        spans = r["spans"]
+        for stage in (
+            "stream_poll_s", "gate_eval_s", "publish_s",
+            "barrier_commit_s", "first_serve_s",
+        ):
+            assert spans.get(stage, -1.0) >= 0.0, (stage, spans)
+        total = sum(spans.values())
+        latency = r["promotion_latency_s"]
+        assert abs(total - latency) <= 0.1 * latency + 0.05, (
+            f"span decomposition {total:.4f}s does not account for "
+            f"promotion_latency_s {latency:.4f}s: {spans}"
+        )
+    # The rollback shares one trace across trip + demotion, and the trip
+    # flight-dumped the ring for the postmortem.
+    assert rolled["trace_id"]
+    trip_dumps = [
+        p
+        for p in private_tracer.flightrec.dumps()
+        if "rollback_trip" in p.name
+    ]
+    assert len(trip_dumps) == 1
+    trip = json.loads(trip_dumps[0].read_text())
+    assert trip["trace_id"] == rolled["trace_id"]
+    assert trip["context"]["from_step"] == s3
+    assert any(
+        r.get("name") == "serve.batch" for r in trip["records"]
+    ), "the flight dump lost the pre-trip serving history"
+    # The summary aggregates the per-stage p50s bench phase 8 records.
+    breakdown = summary["promotion_span_breakdown"]
+    assert breakdown.get("gate_eval_s", 0.0) > 0.0
+    assert breakdown.get("barrier_commit_s", -1.0) >= 0.0
+
+    # And scripts/trace_report.py renders the run's spans into a valid
+    # Chrome trace-event file, filterable to ONE promotion's trace.
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    dump = private_tracer.dump(tmp_path / "trace_spans.json")
+    _sys.path.insert(
+        0, str(_Path(__file__).resolve().parent.parent / "scripts")
+    )
+    try:
+        import trace_report
+    finally:
+        _sys.path.pop(0)
+    out = tmp_path / "promo.chrome.json"
+    tid = post_fleet[-1]["trace_id"]
+    assert trace_report.main(
+        [str(dump), "--trace-id", tid, "--out", str(out)]
+    ) == 0
+    trace = json.loads(out.read_text())
+    span_names = {
+        e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"
+    }
+    assert {
+        "promotion.stream_poll", "promotion.gate_eval",
+        "gate.matrix_eval", "promotion.publish",
+        "promotion.barrier_commit", "reload.commit",
+        "promotion.first_serve",
+    } <= span_names, span_names
+    assert all(
+        e["args"]["trace_id"] == tid
+        for e in trace["traceEvents"]
+        if e.get("ph") == "X"
+    )

@@ -550,9 +550,12 @@ def test_client_retries_backpressure_delivered_through_the_future():
 
         def __init__(self):
             self.calls = 0
+            self.trace_ids = []
 
-        def submit(self, obs, deterministic=True, timeout_s=None):
+        def submit(self, obs, deterministic=True, timeout_s=None,
+                   trace_id=None):
             self.calls += 1
+            self.trace_ids.append(trace_id)
             future = Future()
             if self.calls == 1:
                 future.set_exception(BackpressureError(0.01))
@@ -571,6 +574,11 @@ def test_client_retries_backpressure_delivered_through_the_future():
     result = client.predict_full(np.zeros((1, OBS_DIM), np.float32))
     assert result.model_step == 5
     assert stub.calls == 2, "the future-delivered reject must be retried"
+    # ONE trace ID for the whole logical request: the client mints it
+    # once and re-sends it on every retry attempt (obs/), so the
+    # server-side batch spans of all attempts correlate.
+    assert stub.trace_ids[0] is not None
+    assert stub.trace_ids == [stub.trace_ids[0]] * 2
     # And with the budget exhausted, the reject surfaces.
     stub2 = StubTarget()
     with pytest.raises(BackpressureError):
@@ -586,10 +594,15 @@ def test_client_with_no_retries_surfaces_the_reject():
     with MicroBatchScheduler(engine, max_queue=1, window_ms=0.0) as sched:
         client = ServingClient(sched, max_retries=0)
         futures = [sched.submit(_obs(1, seed=0))]
-        try:
-            futures.append(sched.submit(_obs(1, seed=1)))
-        except BackpressureError:
-            pass
+        # Wait for the worker to pick request 0 up (it then sleeps 0.3s
+        # inside the slow engine) before refilling the queue — the queue
+        # is then deterministically full when the client predicts, with
+        # no race against the worker's wakeup.
+        deadline = time.time() + 5.0
+        while sched.queue_depth > 0 and time.time() < deadline:
+            time.sleep(0.001)
+        assert sched.queue_depth == 0, "worker never picked up request 0"
+        futures.append(sched.submit(_obs(1, seed=1)))
         with pytest.raises(BackpressureError):
             client.predict(_obs(1, seed=2))
         for f in futures:
