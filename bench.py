@@ -42,6 +42,15 @@ Measures, inside one process and one JSON line:
   into a 2-replica fleet; latency is train-step -> served ``model_step``
   wall time, with the gate's one-compile receipt
   (``pipeline_gate_compiles``) alongside.
+- ``serving_req_per_sec_at_p95_slo``: the capacity number — max
+  sustained OPEN-loop request rate holding a p95 latency target
+  (serving/loadgen.py bisection) on the full sharded+bf16 fleet, with
+  ``serving_sharded_512_p95_ms`` vs ``serving_replicated_512_p95_ms``
+  (same trace, with/without the mesh-backed big-rung slice) and
+  ``serving_bf16_speedup_pct`` beside it. Phases skipped via
+  ``BENCH_SKIP_*`` env vars record the explicit ``"skipped"`` sentinel
+  in their rate fields plus a ``phases_skipped`` list, so "not run"
+  never reads as "regressed to absent".
 
 Hardened against the flaky axon tunnel (round-1 failure mode: the first
 device op hung for minutes and the round recorded nothing):
@@ -64,7 +73,8 @@ rungs), BENCH_SWEEP_SEEDS, BENCH_SWEEP_M, BENCH_SWEEP_REPEATS
 BENCH_FORCE_CPU=1, BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1,
 BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1, BENCH_SKIP_SERVING=1,
 BENCH_SERVING_DURATION_S, BENCH_SKIP_PIPELINE=1, BENCH_PIPELINE_M,
-BENCH_PIPELINE_GATE_M, BENCH_PIPELINE_BUDGET_S.
+BENCH_PIPELINE_GATE_M, BENCH_PIPELINE_BUDGET_S, BENCH_SLO_DURATION_S,
+BENCH_SLO_P95_MS.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -92,6 +102,30 @@ REFERENCE_TRAIN_FORMATION_STEPS_PER_SEC = 255.2
 
 def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
+
+
+# Explicit not-run marker for env-var-skipped phases. Before this, a
+# BENCH_SKIP_SERVING=1 run simply lacked the serving fields —
+# indistinguishable from a run where the phase silently regressed to
+# absent. The sentinel value lands IN the rate fields (consumers must
+# treat it as "not a number, not missing") and the skipped phase names
+# accumulate in ``phases_skipped``.
+SKIPPED = "skipped"
+
+
+def _mark_skipped(result: dict, phase: str, fields) -> None:
+    for f in fields:
+        result[f] = SKIPPED
+    result.setdefault("phases_skipped", []).append(phase)
+
+
+def _num(rec: dict, key: str, default: float = 0.0) -> float:
+    """A record field as a float, treating the ``"skipped"`` sentinel
+    (and any other non-number) as absent."""
+    try:
+        return float(rec.get(key, default))
+    except (TypeError, ValueError):
+        return default
 
 
 M = _env_int("BENCH_M", 4096)  # parallel formations (north-star config)
@@ -442,7 +476,9 @@ def _latest_chip_bench_claim() -> str:
                 # r6) over the single-run ladder (fused_scan r6,
                 # tuned_fused r3-r5, tuned always). Returns
                 # (rate, label) or (0.0, None).
-                sweep = r.get("sweep_env_steps_per_sec_fused_scan")
+                # _num: a "skipped" sentinel in a rate field (phase
+                # disabled by env var) reads as absent, not a crash.
+                sweep = _num(r, "sweep_env_steps_per_sec_fused_scan")
                 if sweep:
                     k = r.get("sweep_num_seeds")
                     label = (
@@ -450,15 +486,13 @@ def _latest_chip_bench_claim() -> str:
                         if k
                         else "fused population sweep"
                     )
-                    return float(sweep), label
-                single = r.get(
-                    "train_env_steps_per_sec_fused_scan",
-                    r.get(
-                        "train_env_steps_per_sec_tuned_fused",
-                        r.get("train_env_steps_per_sec_tuned", 0.0),
-                    ),
+                    return sweep, label
+                single = (
+                    _num(r, "train_env_steps_per_sec_fused_scan")
+                    or _num(r, "train_env_steps_per_sec_tuned_fused")
+                    or _num(r, "train_env_steps_per_sec_tuned")
                 )
-                return float(single or 0.0), "tuned full-PPO train"
+                return single, "tuned full-PPO train"
 
             def _tuned(r: dict) -> float:
                 return _train_claim(r)[0]
@@ -476,7 +510,7 @@ def _latest_chip_bench_claim() -> str:
             # A record file may carry several runs (round 3 mirrors both
             # the full run and a burst-synced re-measure) — claim the
             # best training rate, falling back to the best env rate.
-            rec = max(recs, key=lambda r: (_tuned(r), float(r.get("value", 0.0))))
+            rec = max(recs, key=lambda r: (_tuned(r), _num(r, "value")))
             date = None
             m = re.search(r"measured: (\S+)", text)
             if m:
@@ -1061,7 +1095,13 @@ def main() -> None:
         # is a host-path (routing + coalescing + dispatch) number, the
         # layer the fleet adds; model FLOPs are noise at this size.
         # First serving-side perf number in the trajectory.
-        if os.environ.get("BENCH_SKIP_SERVING") != "1":
+        if os.environ.get("BENCH_SKIP_SERVING") == "1":
+            _mark_skipped(
+                result,
+                "serving",
+                ("serving_requests_per_sec_fleet", "serving_fleet_p95_ms"),
+            )
+        else:
             if time.time() < deadline - 60:
                 try:
                     serving_s = float(
@@ -1125,7 +1165,17 @@ def main() -> None:
         # promotions), the gate's eval throughput, and the compile-once
         # receipts: the gate's whole candidate series must cost ONE eval
         # compile, and serving must stay at <= 1 compile per rung.
-        if os.environ.get("BENCH_SKIP_PIPELINE") != "1":
+        if os.environ.get("BENCH_SKIP_PIPELINE") == "1":
+            _mark_skipped(
+                result,
+                "pipeline",
+                (
+                    "promotion_latency_s_p50",
+                    "promotion_latency_s_p95",
+                    "gate_eval_steps_per_sec",
+                ),
+            )
+        else:
             if time.time() < deadline - 90:
                 try:
                     pipeline_budget = min(
@@ -1220,7 +1270,9 @@ def main() -> None:
         # batch, not per request, is why it holds). Same subprocess /
         # forced-2-device rationale as phase 6. The companion
         # promotion_span_breakdown field rides phase 7's pipeline rep.
-        if os.environ.get("BENCH_SKIP_SERVING") != "1":
+        if os.environ.get("BENCH_SKIP_SERVING") == "1":
+            _mark_skipped(result, "obs", ("tracing_overhead_pct",))
+        else:
             if time.time() < deadline - 60:
                 try:
                     obs_s = float(
@@ -1293,6 +1345,100 @@ def main() -> None:
                     notes.append(f"obs phase failed: {e!r}"[:200])
             else:
                 notes.append("obs phase skipped: deadline")
+        # Phase 9 — SLO-driven sharded serving (serving/sharded.py,
+        # loadgen.py, docs/serving.md "Sharded rungs & the earned
+        # ladder"): three fleets on a forced 2-device CPU driven by the
+        # SAME open-loop trace — replicated baseline, + f32 sharded
+        # big-rung slice, + bf16 slice — then a rate bisection for the
+        # capacity headline: max sustained req/s holding the p95 target
+        # with sharding AND bf16 on, budget-1 compile receipts per rung.
+        # On CPU the sharded 512-rung p95 win is the serving-layer one
+        # (dedicated slice = no queue contention with small requests);
+        # the intra-dispatch compute split needs real multi-chip
+        # hardware, and bf16 is recorded honestly (negative on CPU — a
+        # chip-side number by construction).
+        if os.environ.get("BENCH_SKIP_SERVING") == "1":
+            _mark_skipped(
+                result,
+                "serving_slo",
+                (
+                    "serving_req_per_sec_at_p95_slo",
+                    "serving_sharded_512_p95_ms",
+                    "serving_replicated_512_p95_ms",
+                    "serving_bf16_speedup_pct",
+                ),
+            )
+        else:
+            if time.time() < deadline - 90:
+                try:
+                    slo_s = float(
+                        os.environ.get("BENCH_SLO_DURATION_S", 1.5)
+                    )
+                    slo_p95 = float(
+                        os.environ.get("BENCH_SLO_P95_MS", 50.0)
+                    )
+                    cmd = [
+                        sys.executable,
+                        os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "serve_policy.py",
+                        ),
+                        "--init-policy", "MLPActorCritic",
+                        "--obs-dim", "8",
+                        "--slo-bench", "--replicas", "2",
+                        "--duration", str(slo_s),
+                        "--slo-p95-ms", str(slo_p95),
+                    ]
+                    env = dict(os.environ)
+                    env["JAX_PLATFORMS"] = "cpu"
+                    env["XLA_FLAGS"] = (
+                        env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                    ).strip()
+                    out = subprocess.run(
+                        cmd, capture_output=True, text=True,
+                        timeout=max(deadline - time.time(), 90),
+                        env=env,
+                    )
+                    if out.returncode != 0:
+                        raise RuntimeError(
+                            f"slo bench exited {out.returncode}: "
+                            + out.stderr[-200:]
+                        )
+                    rep = json.loads(out.stdout.strip().splitlines()[-1])
+                    result["serving_req_per_sec_at_p95_slo"] = round(
+                        rep["req_per_sec_at_p95_slo"], 1
+                    )
+                    result["serving_slo_p95_target_ms"] = slo_p95
+                    result["serving_sharded_512_p95_ms"] = round(
+                        rep["sharded_512_p95_ms"], 2
+                    )
+                    result["serving_replicated_512_p95_ms"] = round(
+                        rep["replicated_512_p95_ms"], 2
+                    )
+                    result["serving_bf16_speedup_pct"] = round(
+                        rep["bf16_speedup_pct"], 1
+                    )
+                    result["serving_slo_max_compiles_per_rung"] = int(
+                        rep["max_compiles_per_rung"]
+                    )
+                    result["serving_batch_preempted_total"] = int(
+                        rep["batch_preempted_total"]
+                    )
+                    result["serving_autotuned_ladder"] = rep["autotuned"]
+                    print(
+                        "[bench] serving SLO (2-device CPU, sharded+bf16"
+                        f" on): {rep['req_per_sec_at_p95_slo']:,.0f} "
+                        f"req/s at p95<={slo_p95:.0f}ms; 512-rung p95 "
+                        f"{rep['sharded_512_p95_ms']:.1f}ms sharded vs "
+                        f"{rep['replicated_512_p95_ms']:.1f}ms "
+                        "replicated",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade, don't die
+                    notes.append(f"serving slo phase failed: {e!r}"[:200])
+            else:
+                notes.append("serving slo phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

@@ -18,6 +18,9 @@ from marl_distributedformation_tpu.analysis.rules.cross_module import (
     CrossModuleCallback,
 )
 from marl_distributedformation_tpu.analysis.rules.deprecated import DeprecatedApi
+from marl_distributedformation_tpu.analysis.rules.dispatch_transfer import (
+    DevicePutInDispatchLoop,
+)
 from marl_distributedformation_tpu.analysis.rules.donation import MissingDonate
 from marl_distributedformation_tpu.analysis.rules.f64_promotion import (
     ImplicitF64Promotion,
@@ -55,6 +58,7 @@ RULES = (
     ScanCarryShardingDrift(),
     CrossModuleCallback(),
     SpanInTracedScope(),
+    DevicePutInDispatchLoop(),
 )
 
 

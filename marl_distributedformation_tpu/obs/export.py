@@ -107,6 +107,16 @@ def chrome_trace(
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _REPLICA_KEY = re.compile(r"^replica(\d+)_(.+)$")
+# Per-rung serving gauges (fleet/metrics.py): rung size + inference
+# dtype (+ engine kind, where the key carries one — both kinds can
+# serve the same rung, so e.g. compile receipts need the attribution)
+# become labels, so "which rungs are sharded / bf16 / compiled" is one
+# queryable family, not a key explosion. Kind-keyed first: the plain
+# pattern would swallow "sharded_compiles" as the metric name.
+_RUNG_KIND_KEY = re.compile(
+    r"^rung(\d+)_(f32|bf16)_(replicated|sharded)_(.+)$"
+)
+_RUNG_KEY = re.compile(r"^rung(\d+)_(f32|bf16)_(.+)$")
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -142,9 +152,12 @@ def prometheus_exposition(
 
     ``replica{i}_{metric}`` keys fold into one ``{metric}`` family with
     a ``replica="i"`` label (per-replica series belong under one metric
-    name, not N names). ``*_total`` keys are typed ``counter``, the rest
-    ``gauge``. Non-numeric values are skipped — a snapshot is allowed to
-    carry annotations without breaking the scrape."""
+    name, not N names); ``rung{B}_{dtype}_{metric}`` keys fold into a
+    ``rung_{metric}`` family with ``rung``/``dtype`` labels (the
+    serving ladder's shard/bf16 gauges). ``*_total`` keys are typed
+    ``counter``, the rest ``gauge``. Non-numeric values are skipped — a
+    snapshot is allowed to carry annotations without breaking the
+    scrape."""
     base_labels = [
         (k, str(v)) for k, v in sorted((labels or {}).items())
     ]
@@ -157,8 +170,20 @@ def prometheus_exposition(
         except (TypeError, ValueError):
             continue
         m = _REPLICA_KEY.match(key)
+        rung_kind = _RUNG_KIND_KEY.match(key)
+        rung = _RUNG_KEY.match(key)
         if m:
             metric, extra = m.group(2), [("replica", m.group(1))]
+        elif rung_kind:
+            metric = f"rung_{rung_kind.group(4)}"
+            extra = [
+                ("dtype", rung_kind.group(2)),
+                ("kind", rung_kind.group(3)),
+                ("rung", rung_kind.group(1)),
+            ]
+        elif rung:
+            metric = f"rung_{rung.group(3)}"
+            extra = [("dtype", rung.group(2)), ("rung", rung.group(1))]
         else:
             metric, extra = key, []
         name = _metric_name(metric, namespace)

@@ -31,11 +31,27 @@ is compiled:
   failover), ``FleetReloadCoordinator`` (poll-once batch-barrier swap,
   globally step-monotonic), ``FleetFrontend`` (stdlib HTTP/JSON),
   ``FleetMetrics``, ``run_fleet_smoke``.
+- :class:`~.sharded.ShardedPolicyEngine` — the big rungs over a device
+  mesh slice instead of per-device replicas: partition-rule-driven
+  param placement (``match_partition_rules`` /
+  ``make_shard_and_gather_fns``), batch-axis request sharding, optional
+  bf16 rungs. ``ShardedSpec`` plugs it into a ``FleetRouter``.
+- ``serving.loadgen`` / ``serving.autotune`` — the earned ladder:
+  open-loop traffic replay measuring req/s AT a p95 target
+  (``max_rate_at_slo``), and a deterministic ladder autotuner deriving
+  rungs + coalescing window from the observed distribution
+  (``autotune_ladder``). SLO classes ride admission control —
+  batch-eval traffic yields to interactive under backpressure
+  (``MicroBatchScheduler.submit(slo_class=...)``).
 
 Architecture, bucket-ladder sizing, backpressure semantics, and the
 hot-reload contract are documented in ``docs/serving.md``.
 """
 
+from marl_distributedformation_tpu.serving.autotune import (
+    LadderPlan,
+    autotune_ladder,
+)
 from marl_distributedformation_tpu.serving.client import (
     ServingClient,
     backoff_s,
@@ -44,13 +60,25 @@ from marl_distributedformation_tpu.serving.engine import (
     DEFAULT_BUCKETS,
     BucketedPolicyEngine,
 )
+from marl_distributedformation_tpu.serving.loadgen import (
+    RequestTrace,
+    max_rate_at_slo,
+    run_load,
+    synthetic_trace,
+)
 from marl_distributedformation_tpu.serving.metrics import ServingMetrics
 from marl_distributedformation_tpu.serving.registry import ModelRegistry
 from marl_distributedformation_tpu.serving.scheduler import (
+    SLO_BATCH,
+    SLO_INTERACTIVE,
     BackpressureError,
     MicroBatchScheduler,
     RequestTimeout,
     ServedResult,
+)
+from marl_distributedformation_tpu.serving.sharded import (
+    ShardedPolicyEngine,
+    ShardedSpec,
 )
 from marl_distributedformation_tpu.serving.smoke import run_smoke_benchmark
 
@@ -58,12 +86,22 @@ __all__ = [
     "BackpressureError",
     "BucketedPolicyEngine",
     "DEFAULT_BUCKETS",
+    "LadderPlan",
     "MicroBatchScheduler",
     "ModelRegistry",
     "RequestTimeout",
+    "RequestTrace",
+    "SLO_BATCH",
+    "SLO_INTERACTIVE",
     "ServedResult",
     "ServingClient",
     "ServingMetrics",
+    "ShardedPolicyEngine",
+    "ShardedSpec",
+    "autotune_ladder",
     "backoff_s",
+    "max_rate_at_slo",
+    "run_load",
     "run_smoke_benchmark",
+    "synthetic_trace",
 ]

@@ -75,13 +75,17 @@ class ServingClient:
         deterministic: bool = True,
         timeout_s: Optional[float] = None,
         trace_id: Optional[str] = None,
+        slo_class: str = "interactive",
     ) -> Tuple[np.ndarray, int]:
         """Blocking predict; returns ``(actions, model_step)``.
 
         Raises ``RequestTimeout`` when the request's deadline passes,
         ``BackpressureError`` when the queue stayed full through every
-        retry."""
-        result = self.predict_full(obs, deterministic, timeout_s, trace_id)
+        retry (a batch-class request preempted by interactive traffic
+        surfaces the same way and is retried the same way)."""
+        result = self.predict_full(
+            obs, deterministic, timeout_s, trace_id, slo_class
+        )
         return result.actions, result.model_step
 
     def predict_full(
@@ -90,6 +94,7 @@ class ServingClient:
         deterministic: bool = True,
         timeout_s: Optional[float] = None,
         trace_id: Optional[str] = None,
+        slo_class: str = "interactive",
     ) -> ServedResult:
         wait_s = (
             timeout_s
@@ -105,7 +110,7 @@ class ServingClient:
             try:
                 future = self.scheduler.submit(
                     obs, deterministic=deterministic, timeout_s=timeout_s,
-                    trace_id=trace_id,
+                    trace_id=trace_id, slo_class=slo_class,
                 )
                 # Slack over the request's own deadline: the scheduler
                 # fails expired requests itself; this outer bound only

@@ -19,6 +19,15 @@ constant: a hot-swapped checkpoint with the same architecture reuses the
 existing executable — swapping weights never recompiles. The padded
 observation buffer and the per-dispatch PRNG key are donated (both are
 freshly built per call, so the engine never aliases a live buffer).
+
+``dtype="bfloat16"`` opts a rung ladder into bf16 inference: each rung's
+compiled program casts the float params and the obs to bf16 ON DEVICE
+(part of the fused program — params stay f32 at rest, so hot swaps and
+template validation are untouched and the jit cache keys never change),
+computes the forward pass in bf16, and casts the actions back to f32
+before the clip. The action divergence vs the f32 ladder is bounded the
+same way the sharding parity gates are — an explicit amplification
+budget (``tests/bf16_budget.py``), not a flat tolerance.
 """
 
 from __future__ import annotations
@@ -30,6 +39,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Settle jax_compat's global PRNG normalization (jax_threefry_partitionable)
+# BEFORE any engine compiles: jax config values key the jit cache, so a
+# later lazy import (e.g. parallel.mesh, pulled in the first time a fleet
+# builds a mesh-sharded replica) flipping the flag would invalidate every
+# already-warmed engine's programs — each next dispatch then retraces
+# against its budget-1 guard and the replica circuit-breaks. Importing it
+# here means "an engine exists" implies "the config is final".
+from marl_distributedformation_tpu import jax_compat as _jax_compat  # noqa: F401
 from marl_distributedformation_tpu.analysis.guards import RetraceGuard
 from marl_distributedformation_tpu.models import distributions
 
@@ -56,6 +73,10 @@ class BucketedPolicyEngine:
       seed: base PRNG key for stochastic (non-deterministic) actions; a
         per-dispatch key is derived via ``fold_in`` on a dispatch
         counter, so no key is ever consumed twice.
+      dtype: inference compute dtype. ``None``/"float32" serves f32;
+        "bfloat16" compiles each rung with an in-program cast of float
+        params + obs to bf16 (actions come back f32). Opt-in: the
+        divergence budget is tests/bf16_budget.py's, not zero.
     """
 
     def __init__(
@@ -64,11 +85,19 @@ class BucketedPolicyEngine:
         buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
         max_traces_per_bucket: Optional[int] = 1,
         seed: int = 0,
+        dtype: Optional[str] = None,
     ) -> None:
         self.policy = policy
         self.buckets = tuple(sorted({int(b) for b in buckets}))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.dtype = None if dtype in (None, "float32", "f32") else jnp.dtype(
+            dtype
+        )
+        if self.dtype is not None and self.dtype != jnp.bfloat16:
+            raise ValueError(
+                f"inference dtype must be float32 or bfloat16, got {dtype!r}"
+            )
         self.guards: Dict[int, RetraceGuard] = {
             b: RetraceGuard(
                 f"serving-act-bucket{b}", max_traces=max_traces_per_bucket
@@ -86,17 +115,37 @@ class BucketedPolicyEngine:
 
     # -- compiled path --------------------------------------------------
 
-    def _build_act(self, bucket: int):
+    def _act_core(self, nn_params, obs, key, deterministic):
+        """The traced act body, shared by every rung builder (the mesh
+        subclass wraps it with an in-program key fold)."""
         model = self.policy.model
-
-        def _act(nn_params, obs, key, deterministic):
-            mean, log_std, _ = model.apply(nn_params, obs)
-            sampled = distributions.sample(key, mean, log_std)
-            actions = jnp.where(
-                deterministic, distributions.mode(mean), sampled
+        cast = self.dtype
+        if cast is not None:
+            # In-program bf16 cast: params stay f32 at rest (swap /
+            # validation contract untouched), the forward pass runs
+            # in bf16, actions return f32. Float leaves only — step
+            # counters and integer tables keep their dtypes.
+            nn_params = jax.tree_util.tree_map(
+                lambda x: (
+                    x.astype(cast)
+                    if jnp.issubdtype(x.dtype, jnp.floating)
+                    else x
+                ),
+                nn_params,
             )
-            # Action-space clip, same contract as LoadedPolicy.predict.
-            return jnp.clip(actions, -1.0, 1.0)
+            obs = obs.astype(cast)
+        mean, log_std, _ = model.apply(nn_params, obs)
+        sampled = distributions.sample(key, mean, log_std)
+        actions = jnp.where(
+            deterministic, distributions.mode(mean), sampled
+        )
+        actions = actions.astype(jnp.float32)
+        # Action-space clip, same contract as LoadedPolicy.predict.
+        return jnp.clip(actions, -1.0, 1.0)
+
+    def _build_act(self, bucket: int):
+        def _act(nn_params, obs, key, deterministic):
+            return self._act_core(nn_params, obs, key, deterministic)
 
         # obs + key are freshly materialized per dispatch — donate both.
         # ``deterministic`` rides as a traced bool scalar so ONE program
@@ -139,6 +188,31 @@ class BucketedPolicyEngine:
         """Traces per rung so far (the serving contract: at most 1 each)."""
         return {b: g.count for b, g in self.guards.items()}
 
+    @property
+    def dtype_label(self) -> str:
+        """Short dtype tag for metrics labels ("f32" / "bf16")."""
+        return "bf16" if self.dtype == jnp.bfloat16 else "f32"
+
+    # Dispatch hooks the mesh-sharded subclass overrides: the base
+    # engine calls its jitted rung directly and lets jit place the
+    # padded buffer on the params' device.
+    is_sharded = False
+
+    def _run(
+        self,
+        bucket: int,
+        nn_params: Any,
+        padded: np.ndarray,
+        key: jax.Array,
+        det: np.bool_,
+    ):
+        """One compiled-rung dispatch (the mesh subclass swaps in its
+        AOT-executable path here)."""
+        return self._acts[bucket](nn_params, padded, key, det)
+
+    def _default_params(self) -> Any:
+        return self.policy.params
+
     # -- host-side dispatch ---------------------------------------------
 
     def _next_key(self) -> jax.Array:
@@ -158,7 +232,7 @@ class BucketedPolicyEngine:
         ``nn_params=None`` uses the wrapped policy's own params (the
         registry passes its active snapshot instead)."""
         if nn_params is None:
-            nn_params = self.policy.params
+            nn_params = self._default_params()
         obs = np.asarray(obs, np.float32)
         if obs.ndim < 2:
             raise ValueError(
@@ -179,8 +253,8 @@ class BucketedPolicyEngine:
             k = min(bucket, n - start)
             padded = np.zeros((bucket,) + obs.shape[1:], np.float32)
             padded[:k] = obs[start : start + k]
-            actions = self._acts[bucket](
-                nn_params, padded, self._next_key(), det
+            actions = self._run(
+                bucket, nn_params, padded, self._next_key(), det
             )
             outs.append(np.asarray(actions)[:k])
             start += k

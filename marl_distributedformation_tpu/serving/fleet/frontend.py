@@ -11,10 +11,15 @@ coalescing scheduler and the compiled engines, exactly where TF-Agents
 Protocol (all bodies JSON):
 
 - ``POST /v1/act`` with ``{"obs": [[...row...], ...],
-  "deterministic": true, "timeout_s": 5.0}`` →
+  "deterministic": true, "timeout_s": 5.0,
+  "slo_class": "interactive"}`` →
   ``200 {"actions": [...], "model_step": N, "replica": i,
   "latency_s": x}``. ``model_step`` rides on every response — the
-  fleet's version-pinning contract, end to end.
+  fleet's version-pinning contract, end to end. ``slo_class``
+  (optional, default "interactive") is the admission class: "batch"
+  traffic yields to interactive under backpressure (scheduler SLO
+  classes — it dispatches behind queued interactive work and may be
+  preempted with a 429 when an interactive request needs its slot).
 - Backpressure → ``429`` with ``{"error": "backpressure",
   "retry_after_s": x}`` AND a standard ``Retry-After`` header (integer
   ceiling), so both JSON-aware clients and off-the-shelf HTTP retry
@@ -180,6 +185,12 @@ def _make_handler(router: FleetRouter):
                 timeout_s = req.get("timeout_s")
                 if timeout_s is not None:
                     timeout_s = float(timeout_s)
+                slo_class = str(req.get("slo_class", "interactive"))
+                if slo_class not in ("interactive", "batch"):
+                    raise ValueError(
+                        f"slo_class must be 'interactive' or 'batch', "
+                        f"got {slo_class!r}"
+                    )
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(
                     400,
@@ -190,7 +201,7 @@ def _make_handler(router: FleetRouter):
             try:
                 future = router.submit(
                     obs, deterministic=deterministic, timeout_s=timeout_s,
-                    trace_id=trace_id,
+                    trace_id=trace_id, slo_class=slo_class,
                 )
                 wait = (
                     timeout_s

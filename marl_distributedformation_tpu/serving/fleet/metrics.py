@@ -90,6 +90,13 @@ class FleetMetrics:
             routed = dict(self._routed_per_replica)
         merged: List[float] = []
         healthy = 0
+        # Per-rung shard/dtype gauges: one entry per (rung, dtype) the
+        # fleet's engines serve — "is this rung mesh-sharded", "what has
+        # it compiled" — folded into labeled Prometheus families by
+        # obs/export.py (``rung_sharded{rung=...,dtype=...}``), so the
+        # tracing spine sees the sharded/bf16 engines through the
+        # existing ``GET /v1/metrics`` endpoint.
+        rungs: Dict[str, float] = {}
         for r in replicas:
             m = r.scheduler.metrics
             snap = m.snapshot()
@@ -102,6 +109,29 @@ class FleetMetrics:
             ]
             out[f"replica{r.index}_queue_depth"] = snap["queue_depth"]
             out[f"replica{r.index}_healthy"] = float(r.healthy)
+            out[f"replica{r.index}_batch_preempted_total"] = snap[
+                "batch_preempted_total"
+            ]
+            engine = getattr(r, "engine", None)
+            if engine is not None:
+                dtype = getattr(engine, "dtype_label", "f32")
+                is_sharded = bool(getattr(engine, "is_sharded", False))
+                kind = "sharded" if is_sharded else "replicated"
+                for bucket, count in engine.compile_counts().items():
+                    prefix = f"rung{bucket}_{dtype}"
+                    # "a mesh slice serves this (rung, dtype)" — kept
+                    # per (rung, dtype) deliberately; WHICH engine kind
+                    # compiled what is the kind-labeled gauge below
+                    # (both kinds can serve the same rung, so folding
+                    # compile counts across kinds would make a receipt
+                    # breach unattributable).
+                    rungs[f"{prefix}_sharded"] = max(
+                        rungs.get(f"{prefix}_sharded", 0.0),
+                        float(is_sharded),
+                    )
+                    ckey = f"{prefix}_{kind}_compiles"
+                    rungs[ckey] = max(rungs.get(ckey, 0.0), float(count))
+        out.update(rungs)
         out["fleet_healthy_replicas"] = float(healthy)
         ordered = sorted(merged)
         pct = ServingMetrics._percentile

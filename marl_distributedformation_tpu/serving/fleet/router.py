@@ -71,7 +71,14 @@ _REPLICA_FAULTS = (SchedulerStopped, RetraceError)
 
 @dataclasses.dataclass
 class Replica:
-    """One device's serving stack plus its circuit-breaker state."""
+    """One device's serving stack plus its circuit-breaker state.
+
+    ``kind`` is "replicated" (one full-ladder engine on one device) or
+    "sharded" (the mesh-backed big-rung engine, serving/sharded.py —
+    ``device`` is then the engine's param-sharding tree, which is
+    exactly what the reload coordinator ``device_put``s the restored
+    tree against at commit, so a swap re-places the params under the
+    partition rules once, fleet-wide, at the same barrier)."""
 
     index: int
     device: Any
@@ -81,6 +88,7 @@ class Replica:
     healthy: bool = True
     broken_at: float = 0.0
     break_reason: str = ""
+    kind: str = "replicated"
 
 
 class FleetRouter:
@@ -101,6 +109,14 @@ class FleetRouter:
         builder passes the checkpoint's step).
       logger: optional ``MetricsLogger``; the aggregated fleet snapshot
         is emitted every ``emit_every`` routed requests.
+      sharded: optional ``serving.sharded.ShardedSpec`` — adds ONE
+        mesh-backed big-rung replica (partition-rule params over a dp
+        mesh slice, serving/sharded.py). Requests with at least
+        ``sharded.route_min_rows`` rows route there first; small
+        requests never do (the small rungs stay on the cheap
+        single-device replicas). A broken sharded replica fails its
+        big requests over to the replicated ladder like any other
+        circuit break.
     """
 
     def __init__(
@@ -119,6 +135,7 @@ class FleetRouter:
         metrics: Optional[FleetMetrics] = None,
         logger: Any = None,
         emit_every: int = 200,
+        sharded: Any = None,
     ) -> None:
         import jax
 
@@ -164,6 +181,65 @@ class FleetRouter:
                     registry=registry,
                 )
             )
+        self.sharded_replica: Optional[Replica] = None
+        self._sharded_min_rows = 0
+        if sharded is not None:
+            from marl_distributedformation_tpu.parallel.mesh import (
+                make_mesh,
+            )
+            from marl_distributedformation_tpu.serving.sharded import (
+                ShardedPolicyEngine,
+            )
+
+            mesh = make_mesh(
+                dict(sharded.axis_sizes or {"dp": len(devs)})
+            )
+            sh_engine = ShardedPolicyEngine(
+                policy,
+                mesh,
+                buckets=sharded.buckets,
+                rules=sharded.rules,
+                seed=seed + n,
+                dtype=sharded.dtype,
+            )
+            # The registry cell holds a mesh-placed copy and — the key
+            # move — records the param-sharding TREE as its "device":
+            # the reload coordinator's per-replica
+            # ``device_put(restored, registry.device)`` then re-places
+            # every swap under the partition rules, once, at the same
+            # fleet batch barrier as everyone else.
+            # The engine already placed its own copy at construction —
+            # seed the registry with THAT tree instead of sharding a
+            # second mesh-resident copy (double param memory on the
+            # slice is exactly what sharded serving exists to avoid;
+            # both readers are read-only and a swap replaces only the
+            # registry's pointer).
+            sh_registry = ReplicaRegistry(
+                sh_engine._params_on_mesh,
+                step=initial_step,
+                device=sh_engine.param_shardings,
+            )
+            sh_scheduler = MicroBatchScheduler(
+                sh_engine,
+                registry=sh_registry,
+                max_queue=max_queue,
+                window_ms=(
+                    window_ms
+                    if sharded.window_ms is None
+                    else sharded.window_ms
+                ),
+                default_timeout_s=default_timeout_s,
+            )
+            self.sharded_replica = Replica(
+                index=n,
+                device=mesh,
+                engine=sh_engine,
+                scheduler=sh_scheduler,
+                registry=sh_registry,
+                kind="sharded",
+            )
+            self.replicas.append(self.sharded_replica)
+            self._sharded_min_rows = sharded.route_min_rows
 
     # -- lifecycle -------------------------------------------------------
 
@@ -196,6 +272,7 @@ class FleetRouter:
         timeout_s: Optional[float] = None,
         on_result: Optional[Any] = None,
         trace_id: Optional[str] = None,
+        slo_class: str = "interactive",
     ) -> Future:
         """Route one request; returns a future resolving to
         ``ServedResult`` (with ``.replica`` set). Raises
@@ -216,12 +293,12 @@ class FleetRouter:
         deadline = time.perf_counter() + timeout
         outer: Future = Future()
         replica, inner = self._route(
-            obs, deterministic, timeout_s, set(), trace_id
+            obs, deterministic, timeout_s, set(), trace_id, slo_class
         )
         self._chain(
             replica, inner, outer, obs, deterministic, timeout_s,
             hops=0, tried={replica.index}, deadline=deadline,
-            on_result=on_result, trace_id=trace_id,
+            on_result=on_result, trace_id=trace_id, slo_class=slo_class,
         )
         return outer
 
@@ -234,17 +311,38 @@ class FleetRouter:
         timeout_s: Optional[float],
         tried: Set[int],
         trace_id: Optional[str] = None,
+        slo_class: str = "interactive",
     ) -> Tuple[Replica, Future]:
         """Submit to the best healthy replica not in ``tried``; walk down
-        the drain-time ordering past individually-full replicas."""
+        the drain-time ordering past individually-full replicas.
+
+        Big-rung preference: a request of at least ``sharded.min_rows``
+        rows tries the mesh-backed sharded replica FIRST (that is what
+        the slice exists for), then falls through to the replicated
+        ladder on backpressure or a break. Small requests route to the
+        sharded replica only as a LAST resort (its ladder starts at the
+        big rungs, so a 1-row request there pads 64x — but serving it
+        wastefully still beats a 503 when every replicated replica is
+        broken or full)."""
         self._probe_broken()
+        rows = int(obs.shape[0]) if hasattr(obs, "shape") else 0
+        big = (
+            self.sharded_replica is not None
+            and rows >= self._sharded_min_rows
+        )
+
+        def _pref(r: Replica) -> int:
+            if r.kind == "sharded":
+                return 0 if big else 2
+            return 1
+
         candidates = sorted(
             (
                 r
                 for r in self.replicas
                 if r.healthy and r.index not in tried
             ),
-            key=lambda r: r.scheduler.estimated_drain_s(),
+            key=lambda r: (_pref(r), r.scheduler.estimated_drain_s()),
         )
         rejections: List[BackpressureError] = []
         for r in candidates:
@@ -254,7 +352,7 @@ class FleetRouter:
             try:
                 inner = r.scheduler.submit(
                     obs, deterministic=deterministic, timeout_s=timeout_s,
-                    trace_id=trace_id,
+                    trace_id=trace_id, slo_class=slo_class,
                 )
                 return r, inner
             except BackpressureError as e:
@@ -291,6 +389,7 @@ class FleetRouter:
         deadline: float,
         on_result: Optional[Any] = None,
         trace_id: Optional[str] = None,
+        slo_class: str = "interactive",
     ) -> None:
         """Resolve ``outer`` from ``inner``, failing over replica faults
         onto a fresh replica while the hop budget and deadline allow."""
@@ -329,7 +428,8 @@ class FleetRouter:
                 ):
                     try:
                         nxt, nfut = self._route(
-                            obs, deterministic, timeout_s, tried, trace_id
+                            obs, deterministic, timeout_s, tried,
+                            trace_id, slo_class,
                         )
                     except Exception as routing_exc:  # noqa: BLE001
                         outer.set_exception(routing_exc)
@@ -339,6 +439,7 @@ class FleetRouter:
                         nxt, nfut, outer, obs, deterministic, timeout_s,
                         hops + 1, tried | {nxt.index}, deadline,
                         on_result=on_result, trace_id=trace_id,
+                        slo_class=slo_class,
                     )
                     return
             outer.set_exception(exc)

@@ -40,6 +40,7 @@ class ServingMetrics:
         self.padded_rows_total = 0
         self.rejected_total = 0
         self.timeouts_total = 0
+        self.preempted_total = 0  # batch requests that yielded their slot
         self.queue_depth = 0
 
     # -- recording (scheduler side) -------------------------------------
@@ -56,6 +57,12 @@ class ServingMetrics:
     def record_timeout(self, n: int = 1) -> None:
         with self._lock:
             self.timeouts_total += n
+
+    def record_preempted(self) -> None:
+        """A queued batch-class request was evicted to admit an
+        interactive one (scheduler SLO classes)."""
+        with self._lock:
+            self.preempted_total += 1
 
     def record_batch(
         self,
@@ -126,4 +133,5 @@ class ServingMetrics:
                 "queue_depth": float(self.queue_depth),
                 "rejected_total": float(self.rejected_total),
                 "timeouts_total": float(self.timeouts_total),
+                "batch_preempted_total": float(self.preempted_total),
             }

@@ -25,6 +25,18 @@ Model hot-swap composes here: the worker snapshots ``(params, step)``
 from the registry once per micro-batch, so a swap lands atomically
 between batches and every result records the checkpoint step that
 produced it (``ServedResult.model_step``).
+
+**SLO classes.** Every request carries an admission class —
+``"interactive"`` (the default: a user is waiting) or ``"batch"``
+(eval sweeps, backfills: work that tolerates deferral). Under
+backpressure batch traffic YIELDS: (1) dispatch order prefers queued
+interactive requests, so batch backlog cannot stretch the interactive
+p95; (2) a full queue never rejects an interactive request while batch
+requests are queued — the newest-queued batch request is *preempted*
+(its future fails with ``BackpressureError`` + retry-after, the same
+contract as a door reject, which the client retry loop already honors)
+and the interactive request takes its slot. With all-default traffic
+the queue is plain FIFO — the classes cost nothing until used.
 """
 
 from __future__ import annotations
@@ -34,6 +46,7 @@ import dataclasses
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, List, Optional
 
@@ -62,6 +75,11 @@ class SchedulerStopped(RuntimeError):
     """The scheduler shut down before this request was dispatched."""
 
 
+SLO_INTERACTIVE = "interactive"
+SLO_BATCH = "batch"
+SLO_CLASSES = (SLO_INTERACTIVE, SLO_BATCH)
+
+
 @dataclasses.dataclass
 class ServedResult:
     """What a resolved request future carries."""
@@ -80,11 +98,94 @@ class _Request:
     enqueued: float
     timeout_s: Optional[float]
     trace_id: Optional[str] = None
+    slo_class: str = SLO_INTERACTIVE
 
     def expired(self, now: float) -> bool:
         return self.timeout_s is not None and (
             now - self.enqueued > self.timeout_s
         )
+
+
+class _ClassedQueue:
+    """Bounded two-class request queue: interactive ahead of batch.
+
+    The ``queue.Queue`` subset the scheduler uses (``put_nowait`` /
+    ``get`` / ``get_nowait`` / ``qsize``, ``queue.Full``/``Empty``
+    semantics), with the SLO-class admission policy inside:
+
+    - ``get`` pops the oldest INTERACTIVE request first; batch requests
+      dispatch only when no interactive request is queued (each class
+      stays FIFO within itself).
+    - ``put_nowait`` on a full queue returns the preempted batch
+      request when the arrival is interactive and batch work is queued
+      (newest batch yields — it has waited least), instead of raising
+      ``queue.Full``. The caller owns failing the preempted future.
+
+    A plain lock+deques structure instead of queue.Queue: preemption
+    needs to remove from the middle of the bound, which Queue cannot.
+    """
+
+    def __init__(self, maxsize: int) -> None:
+        self._maxsize = maxsize
+        self._cond = threading.Condition()
+        self._interactive: "deque[_Request]" = deque()
+        self._batch: "deque[_Request]" = deque()
+
+    def qsize(self) -> int:
+        with self._cond:
+            return len(self._interactive) + len(self._batch)
+
+    def put_nowait(self, req: _Request) -> Optional[_Request]:
+        """Admit ``req``; returns a preempted batch request (fail its
+        future) or None. Raises ``queue.Full`` when admission fails."""
+        with self._cond:
+            depth = len(self._interactive) + len(self._batch)
+            lane = (
+                self._batch
+                if req.slo_class == SLO_BATCH
+                else self._interactive
+            )
+            if depth < self._maxsize:
+                lane.append(req)
+                self._cond.notify()
+                return None
+            if req.slo_class != SLO_BATCH and self._batch:
+                evicted = self._batch.pop()
+                self._interactive.append(req)
+                self._cond.notify()
+                return evicted
+            raise queue.Full
+
+    def _pop(self) -> Optional[_Request]:
+        if self._interactive:
+            return self._interactive.popleft()
+        if self._batch:
+            return self._batch.popleft()
+        return None
+
+    def get(self, timeout: Optional[float] = None) -> _Request:
+        deadline = (
+            None if timeout is None else time.perf_counter() + timeout
+        )
+        with self._cond:
+            while True:
+                req = self._pop()
+                if req is not None:
+                    return req
+                if deadline is None:
+                    self._cond.wait()
+                    continue
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    raise queue.Empty
+                self._cond.wait(remaining)
+
+    def get_nowait(self) -> _Request:
+        with self._cond:
+            req = self._pop()
+            if req is None:
+                raise queue.Empty
+            return req
 
 
 class MicroBatchScheduler:
@@ -120,7 +221,7 @@ class MicroBatchScheduler:
         self.metrics = metrics or ServingMetrics()
         self.logger = logger
         self.emit_every = emit_every
-        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
+        self._queue = _ClassedQueue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._busy = False  # worker mid-dispatch (drain estimation)
@@ -133,14 +234,21 @@ class MicroBatchScheduler:
         deterministic: bool = True,
         timeout_s: Optional[float] = None,
         trace_id: Optional[str] = None,
+        slo_class: str = SLO_INTERACTIVE,
     ) -> Future:
         """Enqueue one request of ``(n, *row_shape)`` observation rows.
         Returns a future resolving to :class:`ServedResult`. Raises
         :class:`BackpressureError` when the queue is full. ``trace_id``
         rides the request to the dispatch batch span (obs/) so one ID
-        correlates a request across frontend, router, and batch."""
+        correlates a request across frontend, router, and batch.
+        ``slo_class`` is the admission class (module docstring): batch
+        requests yield to interactive ones under backpressure."""
         if self._thread is None:
             raise RuntimeError("scheduler not started (use start() / with)")
+        if slo_class not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown slo_class {slo_class!r}; known: {SLO_CLASSES}"
+            )
         obs = np.asarray(obs, np.float32)
         if obs.ndim < 2 or obs.shape[0] < 1:
             raise ValueError(
@@ -155,12 +263,23 @@ class MicroBatchScheduler:
                 self.default_timeout_s if timeout_s is None else timeout_s
             ),
             trace_id=trace_id,
+            slo_class=slo_class,
         )
         try:
-            self._queue.put_nowait(req)
+            preempted = self._queue.put_nowait(req)
         except queue.Full:
             self.metrics.record_reject()
             raise BackpressureError(self.retry_after_s()) from None
+        if preempted is not None:
+            # A queued batch request yielded its slot to this
+            # interactive arrival: same reject-with-retry-after
+            # contract as a door reject — the client's existing retry
+            # loop re-submits it once pressure eases.
+            self.metrics.record_preempted()
+            if not preempted.future.done():
+                preempted.future.set_exception(
+                    BackpressureError(self.retry_after_s())
+                )
         if self._stop.is_set():
             # stop() may have drained the queue between our liveness
             # check and the put — there is no worker left to take this

@@ -1,0 +1,356 @@
+"""Mesh-sliced inference: serve the big rungs sharded, not replicated.
+
+The fleet (serving/fleet/) scales by REPLICATION — every replica holds a
+full param copy and full bucket ladder, so per-device memory caps the
+model size and the big rungs burn one whole device each. This module is
+the other scaling axis from ROADMAP item 3: one engine whose compiled
+rungs run over a device-mesh *slice*, with
+
+- **partition-rule-driven placement** (the `match_partition_rules` /
+  `make_shard_and_gather_fns` idiom): a list of ``(regex, PartitionSpec)``
+  rules maps every param leaf — by its ``/``-joined tree path — to a
+  mesh layout, and the derived shard fns place the tree ON the mesh
+  exactly once (at engine build and at reload commit, never per call);
+- **batch-axis request sharding**: the padded request buffer is placed
+  ``P("dp")`` so each mesh device computes its block of rows. With
+  replicated params that is classic data-parallel inference — the
+  per-row math is IDENTICAL to the single-device program, which is why
+  the sharded==replicated parity gate is *bitwise* at f32, not a
+  tolerance;
+- an optional ``"mp"`` mesh axis for rules that split wide kernels over
+  their OUTPUT feature axis (contraction dim intact — no reduction
+  reordering, parity stays bitwise). Rules whose axes the mesh lacks, or
+  whose dims don't divide, degrade to replication per-leaf instead of
+  failing: one rule set serves every mesh shape.
+
+The engine keeps the whole ``BucketedPolicyEngine`` contract (bucket
+ladder, budget-1 RetraceGuards, fold_in keys, traced ``deterministic``),
+so the fleet router can treat it as one more replica — the routing layer
+sends big-rung requests here and keeps small rungs on the cheap
+single-device replicas (serving/fleet/router.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from marl_distributedformation_tpu.serving.engine import BucketedPolicyEngine
+
+# Default rules for this repo's actor-critic family: tower kernels may
+# split over an "mp" axis on their OUTPUT features (bias splits with
+# them); scalars and everything unmatched replicate. On a dp-only mesh
+# every rule degrades to P() — pure data parallelism.
+DEFAULT_PARTITION_RULES: Tuple[Tuple[str, P], ...] = (
+    ("log_std", P()),
+    (r"(pi|vf)_\d+/kernel", P(None, "mp")),
+    (r"(pi|vf)_\d+/bias", P("mp")),
+    (r".*", P()),
+)
+
+DEFAULT_SHARDED_BUCKETS = (64, 512)
+
+
+def _tree_paths(tree: Any, sep: str = "/") -> List[Tuple[str, Any]]:
+    """Flatten a pytree into ``(joined_path, leaf)`` pairs — the name a
+    partition rule matches against (dict keys joined by ``sep``)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for entry in path:
+            key = getattr(entry, "key", None)
+            if key is None:
+                key = getattr(entry, "idx", None)
+            parts.append(str(key))
+        out.append((sep.join(parts), leaf))
+    return out
+
+
+def fit_spec_to_mesh(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Degrade a PartitionSpec to what ``mesh`` and ``shape`` support:
+    axes the mesh doesn't have, or whose mesh size doesn't divide the
+    dim, fall back to ``None`` (replicated on that dim). Keeps one rule
+    set valid across every mesh topology and every head width."""
+    axes = []
+    for i, ax in enumerate(tuple(spec)):
+        ok = (
+            ax is not None
+            and ax in mesh.shape
+            and i < len(shape)
+            and shape[i] % mesh.shape[ax] == 0
+        )
+        axes.append(ax if ok else None)
+    while axes and axes[-1] is None:
+        axes.pop()
+    return P(*axes)
+
+
+def match_partition_rules(
+    rules: Sequence[Tuple[str, P]], params: Any, mesh: Mesh
+) -> Any:
+    """Pytree of PartitionSpec from ``(regex, spec)`` rules, matched
+    against each leaf's ``/``-joined path (first match wins — the
+    fmengine/EasyLM idiom). Scalars never partition; matched specs are
+    fitted to the mesh (see :func:`fit_spec_to_mesh`). Raises when no
+    rule matches a leaf — ship a catch-all as the last rule."""
+
+    def spec_for(name: str, leaf: Any) -> P:
+        shape = tuple(np.shape(leaf))
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name) is not None:
+                return fit_spec_to_mesh(spec, shape, mesh)
+        raise ValueError(f"no partition rule matched param {name!r}")
+
+    named = {n: spec_for(n, leaf) for n, leaf in _tree_paths(params)}
+    leaves = [named[n] for n, _ in _tree_paths(params)]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def make_shard_and_gather_fns(
+    specs: Any, mesh: Mesh
+) -> Tuple[Any, Any]:
+    """Pytrees of per-leaf shard / gather callables from a spec tree.
+
+    ``shard_fn(leaf)`` places the leaf on the mesh under its
+    NamedSharding — called ONCE per placement event (engine build,
+    reload commit), never on the request path. ``gather_fn(leaf)``
+    brings a mesh-resident leaf back to one host array (checkpointing /
+    debugging — serving never gathers params)."""
+
+    def _make(spec: P):
+        sharding = NamedSharding(mesh, spec)
+
+        def shard_fn(leaf: Any) -> Any:
+            return jax.device_put(leaf, sharding)
+
+        def gather_fn(leaf: Any) -> np.ndarray:
+            return np.asarray(jax.device_get(leaf))
+
+        return shard_fn, gather_fn
+
+    # PartitionSpec is tuple-shaped — without is_leaf, tree_map would
+    # recurse INTO each spec (and an empty P() would flatten to nothing).
+    pairs = jax.tree_util.tree_map(
+        _make, specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    shard_fns = jax.tree_util.tree_map(
+        lambda p: p[0], pairs, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    gather_fns = jax.tree_util.tree_map(
+        lambda p: p[1], pairs, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    return shard_fns, gather_fns
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedSpec:
+    """How a fleet builds its mesh-backed big-rung engine.
+
+    ``axis_sizes`` follows ``parallel.mesh.make_mesh`` (``{"dp": -1}``
+    = every local device on the batch axis). ``min_rows`` is the routing
+    threshold: requests with at least this many rows prefer the sharded
+    engine; smaller ones stay on the single-device replicas. ``dtype``
+    opts the sharded rungs into bf16. ``window_ms`` is the slice's own
+    coalescing window (``None`` inherits the fleet's): a dedicated lane
+    whose routing floor fills its smallest rung has nothing to coalesce,
+    so the autotuner emits 0.0 there (``LadderPlan.sharded_window_ms``)
+    — waiting would be pure added latency on every big request."""
+
+    axis_sizes: Optional[Dict[str, int]] = None
+    buckets: Tuple[int, ...] = DEFAULT_SHARDED_BUCKETS
+    min_rows: Optional[int] = None
+    dtype: Optional[str] = None
+    rules: Tuple[Tuple[str, P], ...] = DEFAULT_PARTITION_RULES
+    window_ms: Optional[float] = None
+
+    @property
+    def route_min_rows(self) -> int:
+        return self.min_rows if self.min_rows else min(self.buckets)
+
+
+class ShardedPolicyEngine(BucketedPolicyEngine):
+    """``BucketedPolicyEngine`` whose rungs run over a device-mesh slice.
+
+    Same compiled-path contract as the base engine (one compile per
+    rung, ever; params an argument, not a constant), with placement
+    changed from "one device" to "one mesh": params live under their
+    partition-rule shardings (placed once — at construction here, at
+    the barrier commit by the fleet coordinator), the padded request
+    buffer enters under the ``P("dp")`` batch layout (fresh data HAS
+    to cross the host boundary; the graftlint rule-16 hazard is
+    re-placing *params* per call), and each rung runs as an AOT
+    executable lowered once against those committed layouts — steady
+    state hands the host buffer straight to the executable, so the
+    request path carries no python-level ``device_put`` at all (see
+    ``_run``) and the program is stable across swaps.
+
+    Every bucket must divide by the ``dp`` axis size — the batch rows
+    split evenly across the slice (the default 64/512 rungs divide any
+    power-of-two dp width).
+    """
+
+    is_sharded = True
+
+    def __init__(
+        self,
+        policy: Any,
+        mesh: Mesh,
+        buckets: Tuple[int, ...] = DEFAULT_SHARDED_BUCKETS,
+        rules: Sequence[Tuple[str, P]] = DEFAULT_PARTITION_RULES,
+        max_traces_per_bucket: Optional[int] = 1,
+        seed: int = 0,
+        dtype: Optional[str] = None,
+    ) -> None:
+        if "dp" not in mesh.shape:
+            raise ValueError(
+                f"sharded serving needs a 'dp' mesh axis for the request "
+                f"batch; mesh has {dict(mesh.shape)}"
+            )
+        dp = mesh.shape["dp"]
+        bad = [b for b in buckets if b % dp != 0]
+        if bad:
+            raise ValueError(
+                f"sharded buckets must divide by dp={dp}; {bad} do not "
+                "(rows split evenly across the mesh slice)"
+            )
+        self.mesh = mesh
+        self.rules = tuple(rules)
+        self.param_specs = match_partition_rules(
+            self.rules, policy.params, mesh
+        )
+        self.param_shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            self.param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self._shard_fns, self._gather_fns = make_shard_and_gather_fns(
+            self.param_specs, mesh
+        )
+        # Requests shard on their leading (batch) axis; trailing feature
+        # dims stay local to each device. One partial spec covers every
+        # request rank.
+        self._batch_sharding = NamedSharding(mesh, P("dp"))
+        # Place the wrapped policy's own params once, now — the
+        # standalone default for nn_params=None (fleet dispatches pass
+        # the registry snapshot, itself placed once at commit).
+        self._params_on_mesh = self.shard_params(policy.params)
+        # Per-rung AOT executables, built lazily on first dispatch (see
+        # _run). The lock serializes the one lowering per rung — a
+        # concurrent lower would burn a second trace against the
+        # budget-1 guard.
+        self._compiled: Dict[int, Any] = {}
+        self._compile_lock = threading.Lock()
+        self._seed = int(seed)
+        super().__init__(
+            policy,
+            buckets=buckets,
+            max_traces_per_bucket=max_traces_per_bucket,
+            seed=seed,
+            dtype=dtype,
+        )
+
+    # -- placement (the once-per-event path) -----------------------------
+
+    def shard_params(self, params: Any) -> Any:
+        """Place a host (or anywhere) param tree onto the mesh under the
+        partition rules. The ONLY sanctioned placement path — called at
+        engine build and reload commit, never per request."""
+        return jax.tree_util.tree_map(
+            lambda f, leaf: f(leaf), self._shard_fns, params
+        )
+
+    def gather_params(self, params: Any) -> Any:
+        """Gather a mesh-resident tree back to host arrays."""
+        return jax.tree_util.tree_map(
+            lambda f, leaf: f(leaf), self._gather_fns, params
+        )
+
+    # -- compiled path ---------------------------------------------------
+
+    def _build_act(self, bucket: int):
+        """Rungs take the DISPATCH COUNTER, not a PRNG key: the per-call
+        ``fold_in`` is itself a jit dispatch on the host (~0.27 ms
+        measured on this container), so the sharded program derives
+        ``fold_in(PRNGKey(seed), counter)`` in-program instead — fused
+        into the rung, off the host path. Bitwise identical to the base
+        engine's host-side fold (pinned by the parity gate): same seed,
+        same counter sequence, same threefry bits."""
+        seed = self._seed
+
+        def _act(nn_params, obs, counter, deterministic):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+            return self._act_core(nn_params, obs, key, deterministic)
+
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        return jax.jit(
+            self.guards[bucket].wrap(_act), donate_argnums=donate
+        )
+
+    def _next_key(self):
+        # The counter rides as a strong uint32 scalar (no weak-type
+        # retrace); the program folds it into the key (see _build_act).
+        with self._lock:
+            count = self._dispatches
+            self._dispatches += 1
+        return np.uint32(count)
+
+    # -- per-dispatch hooks ---------------------------------------------
+
+    def _run(
+        self,
+        bucket: int,
+        nn_params: Any,
+        padded: np.ndarray,
+        key: jax.Array,
+        det: np.bool_,
+    ):
+        """Dispatch through a per-rung AOT executable.
+
+        The first dispatch of a rung places the padded buffer under the
+        ``P("dp")`` batch sharding, lowers the guarded jit against that
+        committed layout, and caches ``.compile()``'s executable — the
+        one trace the budget-1 RetraceGuard permits. Every later
+        dispatch hands the HOST buffer straight to the executable: the
+        runtime ingests it under the compiled input layout itself,
+        skipping both pjit's python dispatch (arg-sharding resolution
+        per call) and a per-request ``jax.device_put`` on the request
+        path (measured p50 1.31 ms vs 1.54 ms for the pjit+device_put
+        path at the 512 rung on the dp=2 CPU mesh — and rule-16 clean
+        by construction). Fresh data still crosses the host boundary
+        exactly once; *params* never do (placed at build / reload
+        commit only).
+
+        A hot swap keeps the executable: new param trees arrive under
+        the same shardings/avals (placed by ``shard_params`` at the
+        barrier commit), and an executable call is aval-strict — a
+        structure or layout drift raises instead of silently
+        recompiling, the same contract the RetraceGuard enforces on the
+        pjit path.
+        """
+        exe = self._compiled.get(bucket)
+        if exe is None:
+            with self._compile_lock:
+                exe = self._compiled.get(bucket)
+                if exe is None:
+                    placed = jax.device_put(padded, self._batch_sharding)
+                    exe = (
+                        self._acts[bucket]
+                        .lower(nn_params, placed, key, det)
+                        .compile()
+                    )
+                    self._compiled[bucket] = exe
+                    return exe(nn_params, placed, key, det)
+        return exe(nn_params, padded, key, det)
+
+    def _default_params(self) -> Any:
+        return self._params_on_mesh

@@ -27,6 +27,19 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 from mirror_bench import _load_record as load_record  # noqa: E402
 
+# bench.py writes this sentinel into the rate fields of phases disabled
+# by BENCH_SKIP_* env vars — "explicitly not run", distinct from both a
+# healthy number and a silently-absent field. Structural validators
+# treat sentinel fields as absent; --require rejects them with a message
+# that says WHY the field is empty.
+SKIPPED = "skipped"
+
+
+def _present(rec: dict, key: str):
+    """Field value, with None for both absent and explicitly-skipped."""
+    v = rec.get(key)
+    return None if v == SKIPPED else v
+
 
 def _pipeline_problems(rec: dict) -> list[str]:
     """Structural validation of the always-learning pipeline fields
@@ -35,8 +48,8 @@ def _pipeline_problems(rec: dict) -> list[str]:
     percentile pair, or a gate that compiled more than once, is a
     malformed record regardless of which stage required the fields."""
     problems = []
-    p50 = rec.get("promotion_latency_s_p50")
-    p95 = rec.get("promotion_latency_s_p95")
+    p50 = _present(rec, "promotion_latency_s_p50")
+    p95 = _present(rec, "promotion_latency_s_p95")
     if (p50 is None) != (p95 is None):
         problems.append(
             "promotion_latency_s_p50/p95 must be recorded together"
@@ -86,7 +99,7 @@ def _obs_problems(rec: dict) -> list[str]:
     breakdown whose stages overshoot the latency they decompose, is a
     malformed record."""
     problems = []
-    pct = rec.get("tracing_overhead_pct")
+    pct = _present(rec, "tracing_overhead_pct")
     if pct is not None:
         try:
             if not math.isfinite(float(pct)):
@@ -145,6 +158,57 @@ def _obs_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _serving_slo_problems(rec: dict) -> list[str]:
+    """Structural validation of the SLO serving fields (bench phase 9):
+    whenever a record carries the req/s-at-SLO headline, the load-gen
+    rate and both 512-rung percentiles must be positive numbers, the
+    bf16 delta a finite number, and the compile receipts budget-1."""
+    problems = []
+    rate = _present(rec, "serving_req_per_sec_at_p95_slo")
+    if rate is None:
+        return problems
+    try:
+        if not float(rate) > 0.0:
+            problems.append(
+                f"serving_req_per_sec_at_p95_slo={rate!r} (need > 0: a "
+                "0 rate means even the lowest probe violated the SLO)"
+            )
+    except (TypeError, ValueError):
+        problems.append(
+            f"serving_req_per_sec_at_p95_slo is not a number: {rate!r}"
+        )
+    for key in (
+        "serving_sharded_512_p95_ms",
+        "serving_replicated_512_p95_ms",
+    ):
+        v = _present(rec, key)
+        try:
+            ok = v is not None and float(v) > 0.0
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            problems.append(
+                f"{key}={v!r} beside the SLO rate (need a positive p95)"
+            )
+    bf16 = _present(rec, "serving_bf16_speedup_pct")
+    try:
+        bf16_ok = bf16 is not None and math.isfinite(float(bf16))
+    except (TypeError, ValueError):
+        bf16_ok = False
+    if not bf16_ok:
+        problems.append(
+            f"serving_bf16_speedup_pct={bf16!r} (need a finite number; "
+            "negative is legitimate on CPU)"
+        )
+    receipts = _present(rec, "serving_slo_max_compiles_per_rung")
+    if receipts != 1:
+        problems.append(
+            f"serving_slo_max_compiles_per_rung={receipts!r} — every "
+            "rung (sharded and bf16 included) must compile exactly once"
+        )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -159,7 +223,14 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
         problems.append(f"degraded phases in notes: {notes!r}")
     problems.extend(_pipeline_problems(rec))
     problems.extend(_obs_problems(rec))
+    problems.extend(_serving_slo_problems(rec))
     for field in require:
+        if rec.get(field) == SKIPPED:
+            problems.append(
+                f"required field explicitly skipped (phase disabled "
+                f"via BENCH_SKIP_*): {field}"
+            )
+            continue
         try:
             ok = float(rec.get(field, 0.0)) > 0.0
         except (TypeError, ValueError):

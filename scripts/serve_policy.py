@@ -36,6 +36,7 @@ reload + optional HTTP frontend, docs/serving.md "Fleet").
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -136,11 +137,266 @@ def _build_init_policy(args):
             f"unknown policy {args.init_policy!r}; known: "
             f"{sorted(POLICY_REGISTRY)}"
         )
-    model = POLICY_REGISTRY[args.init_policy](act_dim=2)
+    kwargs = {}
+    if getattr(args, "hidden", None):
+        hidden = tuple(int(w) for w in args.hidden.split(","))
+        kwargs["hidden"] = hidden
+    model = POLICY_REGISTRY[args.init_policy](act_dim=2, **kwargs)
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, args.obs_dim))
     )
-    return LoadedPolicy(dict(variables), policy=args.init_policy)
+    return LoadedPolicy(
+        dict(variables), policy=args.init_policy, model_kwargs=kwargs
+    )
+
+
+def _run_slo_bench(args) -> int:
+    """bench.py phase 9: the SLO-driven serving bench, one JSON line.
+
+    Three fleets on the same forced multi-device CPU (or real mesh),
+    driven by the SAME open-loop request trace (serving/loadgen.py):
+
+    1. replicated-only baseline (the PR-4 fleet shape);
+    2. + f32 sharded big-rung slice (serving/sharded.py);
+    3. + bf16 sharded slice — the "sharding and bf16 on" config, which
+       also runs the bisection for ``req_per_sec_at_p95_slo``.
+
+    Three design rules keep the comparison honest on a small shared
+    box (each was a measured failure mode of the naive version):
+
+    - **Thread-matched topologies.** A sharded config spends one unit
+      of its worker budget on the mesh slice (``replicas - 1``
+      single-device replicas + the slice), so every fleet runs the
+      same number of scheduler threads — the naive "replicas + slice"
+      shape oversubscribes the cores and books the scheduling penalty
+      to sharding.
+    - **Dedicated big-rung lane.** The slice serves ONLY the big rung
+      (``min_rows = big``): big requests never queue behind the small
+      stream, small requests never contend the mesh. This is the
+      earned-ladder shape the autotuner picks, and the serving-layer
+      claim the p95 split measures. The per-dispatch side rides the
+      sharded engine's AOT executables (serving/sharded.py ``_run``),
+      which on the dp=2 CPU mesh are ~13% faster than the replicated
+      pjit dispatch — the compute split itself only materializes on
+      real multi-chip hardware.
+    - **Interleaved best-of-N.** Each config is replayed ``--slo-passes``
+      times in rotated order against long-lived pre-warmed fleets, and
+      the reported p95 is each config's best pass — back-to-back
+      single passes book container load drift to whichever config hits
+      the bad window (the PR-6 bench discipline).
+
+    The autotuner runs on the same trace, so the report carries the
+    earned ladder beside the measured one.
+    """
+    import numpy as np
+
+    from marl_distributedformation_tpu.serving import (
+        ShardedSpec,
+        max_rate_at_slo,
+        run_load,
+        synthetic_trace,
+    )
+    from marl_distributedformation_tpu.serving.autotune import (
+        autotune_ladder,
+    )
+    from marl_distributedformation_tpu.serving.fleet import (
+        FleetRouter,
+        warmup_fleet,
+    )
+
+    replicas = args.replicas or 2
+    mesh_devices = args.mesh_devices or replicas
+    _ensure_cpu_devices(max(replicas, mesh_devices))
+    policy = _build_init_policy(args) if args.init_policy else None
+    if policy is None:
+        from marl_distributedformation_tpu.compat.policy import (
+            LoadedPolicy,
+        )
+        from marl_distributedformation_tpu.utils.checkpoint import (
+            latest_checkpoint,
+        )
+
+        path = latest_checkpoint(Path(args.log_dir))
+        if path is None:
+            raise SystemExit(f"no checkpoint under {args.log_dir}")
+        policy = LoadedPolicy.from_checkpoint(path)
+    row_shape = (
+        (args.agents, args.obs_dim)
+        if args.obs_dim and args.agents
+        else (args.obs_dim,)
+        if args.obs_dim
+        else _infer_row_shape(policy)
+    )
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    big = args.big_rung
+    if big not in buckets:
+        raise SystemExit(
+            f"--big-rung {big} must be one of the ladder rungs {buckets}"
+        )
+    # The slice serves the big rung only — the earned-ladder lane shape
+    # (see docstring). Big rungs are ~20% of requests so the mixed
+    # stream queues the replicated lanes; rate sized so the small model
+    # keeps up on CPU.
+    sharded_buckets = (big,)
+    size_mix = ((1, 0.4), (8, 0.2), (64, 0.2), (big, 0.2))
+    trace = synthetic_trace(
+        args.duration, args.load_rps, seed=7, size_mix=size_mix
+    )
+
+    def _fleet(sharded):
+        # Thread-matched: the slice replaces one replicated replica, so
+        # every config runs `replicas` scheduler workers total.
+        n = replicas if sharded is None else max(1, replicas - 1)
+        return FleetRouter(
+            policy,
+            num_replicas=n,
+            buckets=buckets,
+            window_ms=args.window_ms,
+            max_queue=args.queue,
+            sharded=sharded,
+        )
+
+    def _spec(dtype=None):
+        # window_ms=0: the dedicated lane's requests fill the rung on
+        # arrival, so there is nothing to coalesce (the autotuner emits
+        # exactly this as LadderPlan.sharded_window_ms for this trace).
+        return ShardedSpec(
+            axis_sizes={"dp": mesh_devices},
+            buckets=sharded_buckets,
+            min_rows=big,
+            dtype=dtype,
+            window_ms=0.0,
+        )
+
+    report = {
+        "slo_p95_target_ms": float(args.slo_p95_ms),
+        "replicas": replicas,
+        "mesh_devices": mesh_devices,
+        "buckets": ",".join(str(b) for b in buckets),
+        "big_rung": big,
+        "passes": args.slo_passes,
+    }
+    max_compiles = 0
+
+    def _best(label, key, value):
+        """Fold one pass's p95 into the config's best (ignoring empty
+        passes — a pass with no completions at a size reports 0.0)."""
+        if value <= 0:
+            return
+        prev = report.get(key)
+        report[key] = value if prev is None or prev <= 0 else min(
+            prev, value
+        )
+
+    configs = [
+        ("replicated", None),
+        ("sharded", _spec()),
+        ("bf16", _spec("bfloat16")),
+    ]
+    settle = synthetic_trace(
+        min(1.0, args.duration), args.load_rps, seed=11, size_mix=size_mix
+    )
+    with contextlib.ExitStack() as stack:
+        routers = {}
+        for label, spec in configs:
+            router = stack.enter_context(_fleet(spec))
+            warmup_fleet(router, row_shape)
+            routers[label] = router
+        # One unrecorded settle replay per fleet: the first open-loop
+        # minutes of a fresh process run 2-4x over the steady-state
+        # floor (allocator/thread-pool/frequency ramp), and booking that
+        # decay to whichever config is measured first was the dominant
+        # noise term in earlier versions of this bench.
+        for label, _ in configs:
+            run_load(routers[label], settle, row_shape, seed=11)
+        # Fixed passes, then adaptive extension: while any config's best
+        # p95 still improved >10% in the last round, the process hasn't
+        # found its quiet-window floor yet (a noisy container minute at
+        # the start must not decide the comparison) — keep going, up to
+        # 4 extra rounds.
+        rounds = 0
+        while rounds < max(1, args.slo_passes) + 4:
+            i = rounds
+            before = {
+                label: report.get(f"{label}_{big}_p95_ms", 0.0)
+                for label, _ in configs
+            }
+            for label, _ in configs[i % 3:] + configs[: i % 3]:
+                rep = run_load(routers[label], trace, row_shape, seed=7)
+                _best(
+                    label,
+                    f"{label}_{big}_p95_ms",
+                    rep.per_size_p95_ms.get(big, 0.0),
+                )
+                _best(label, f"{label}_p95_ms", rep.p95_ms)
+            rounds += 1
+            if rounds >= max(1, args.slo_passes):
+                settled = all(
+                    before[label] > 0
+                    and report[f"{label}_{big}_p95_ms"]
+                    > 0.9 * before[label]
+                    for label, _ in configs
+                )
+                if settled:
+                    break
+        report["passes"] = rounds
+        for key in list(report):
+            if key.endswith("_p95_ms") and not isinstance(
+                report[key], float
+            ):
+                report[key] = float(report[key])
+        report.setdefault(f"replicated_{big}_p95_ms", 0.0)
+        report.setdefault(f"sharded_{big}_p95_ms", 0.0)
+        report.setdefault(f"bf16_{big}_p95_ms", 0.0)
+        f32_p95 = report[f"sharded_{big}_p95_ms"]
+        bf16_p95 = report[f"bf16_{big}_p95_ms"]
+        report["bf16_speedup_pct"] = (
+            100.0 * (f32_p95 / bf16_p95 - 1.0) if bf16_p95 > 0 else 0.0
+        )
+
+        # The capacity number: max sustained open-loop rate holding the
+        # p95 target, on the full config (sharded slice + bf16 rungs
+        # ON) — the same long-lived fleet the comparison measured.
+        best, probes = max_rate_at_slo(
+            routers["bf16"],
+            row_shape,
+            p95_target_ms=args.slo_p95_ms,
+            lo_rps=args.load_rps / 2,
+            hi_rps=args.load_rps * 8,
+            probe_duration_s=min(1.0, args.duration),
+            iterations=args.slo_iterations,
+            seed=7,
+            size_mix=size_mix,
+            batch_fraction=0.1,
+            probe_retries=2,
+        )
+        preempted = sum(
+            r.scheduler.metrics.preempted_total
+            for r in routers["bf16"].replicas
+        )
+        for router in routers.values():
+            for counts in router.compile_counts().values():
+                max_compiles = max(max_compiles, *counts.values())
+    report["req_per_sec_at_p95_slo"] = best
+    report["slo_probes"] = len(probes)
+    report["max_compiles_per_rung"] = max_compiles
+    report["batch_preempted_total"] = preempted
+
+    plan = autotune_ladder(
+        trace,
+        p95_target_ms=args.slo_p95_ms,
+        mesh_divisor=mesh_devices,
+        sharded_min_rows=min(sharded_buckets),
+    )
+    report["autotuned"] = plan.to_dict()
+    print(json.dumps(report), flush=True)
+    if report[f"sharded_{big}_p95_ms"] <= 0:
+        print(
+            "[serve] slo bench measured no big-rung completions — failing",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 def _run_fleet(args) -> int:
@@ -157,6 +413,16 @@ def _run_fleet(args) -> int:
     )
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    sharded = None
+    if args.sharded:
+        from marl_distributedformation_tpu.serving import ShardedSpec
+
+        sharded = ShardedSpec(
+            axis_sizes=(
+                {"dp": args.mesh_devices} if args.mesh_devices else None
+            ),
+            dtype="bfloat16" if args.bf16 else None,
+        )
     logger = None
     coordinator = None
     if args.init_policy:
@@ -167,6 +433,7 @@ def _run_fleet(args) -> int:
             buckets=buckets,
             window_ms=args.window_ms,
             max_queue=args.queue,
+            sharded=sharded,
         )
     elif args.log_dir:
         from marl_distributedformation_tpu.utils.logging import MetricsLogger
@@ -182,6 +449,7 @@ def _run_fleet(args) -> int:
             max_queue=args.queue,
             poll_interval_s=args.poll_s,
             logger=logger,
+            sharded=sharded,
         )
         policy = router.policy
         print(
@@ -276,6 +544,12 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--obs-dim", type=int, help="request row width")
     parser.add_argument(
+        "--hidden",
+        help="with --init-policy: comma-separated tower widths "
+        "(default the model's own, 64,64) — the SLO bench widens the "
+        "net so big-rung compute is non-trivial",
+    )
+    parser.add_argument(
         "--agents",
         type=int,
         help="agents per formation — required for per-formation policies "
@@ -347,6 +621,64 @@ def main(argv=None) -> int:
         "port (0 = ephemeral; the bound port is printed to stderr)",
     )
     parser.add_argument(
+        "--sharded",
+        action="store_true",
+        help="with --fleet: add the mesh-backed big-rung replica "
+        "(serving.sharded — partition-rule params over a dp slice of "
+        "the local devices; big requests route there)",
+    )
+    parser.add_argument(
+        "--bf16",
+        action="store_true",
+        help="with --sharded: serve the sharded rungs in bfloat16 "
+        "(opt-in; divergence bounded by tests/bf16_budget.py)",
+    )
+    parser.add_argument(
+        "--mesh-devices",
+        type=int,
+        help="dp width of the sharded mesh slice (default: the fleet "
+        "replica count)",
+    )
+    parser.add_argument(
+        "--slo-bench",
+        action="store_true",
+        help="run the SLO-driven serving bench (bench.py phase 9): "
+        "replicated vs sharded vs bf16 under the same open-loop load "
+        "trace, then bisect for req/s at the p95 target; one JSON line",
+    )
+    parser.add_argument(
+        "--slo-p95-ms",
+        type=float,
+        default=50.0,
+        help="p95 latency target for --slo-bench (default 50 ms)",
+    )
+    parser.add_argument(
+        "--slo-iterations",
+        type=int,
+        default=5,
+        help="rate-bisection steps for --slo-bench (default 5)",
+    )
+    parser.add_argument(
+        "--slo-passes",
+        type=int,
+        default=4,
+        help="interleaved replay passes per config for --slo-bench; "
+        "each config reports its best pass (default 4, extended "
+        "adaptively while any config's floor still improves)",
+    )
+    parser.add_argument(
+        "--load-rps",
+        type=float,
+        default=300.0,
+        help="base offered rate for the --slo-bench comparison trace",
+    )
+    parser.add_argument(
+        "--big-rung",
+        type=int,
+        default=512,
+        help="the rung the sharded-vs-replicated p95 comparison tracks",
+    )
+    parser.add_argument(
         "--obs-trace",
         choices=("on", "off"),
         default="on",
@@ -360,8 +692,15 @@ def main(argv=None) -> int:
 
     obs.configure(enabled=args.obs_trace == "on")
 
+    if args.slo_bench:
+        return _run_slo_bench(args)
+
     if (args.port is not None or args.replicas is not None) and not args.fleet:
         raise SystemExit("--port/--replicas require --fleet")
+    if (args.sharded or args.bf16) and not args.fleet:
+        raise SystemExit("--sharded/--bf16 require --fleet")
+    if args.bf16 and not args.sharded:
+        raise SystemExit("--bf16 requires --sharded")
 
     if args.scenario:
         # Resolve against the registry BEFORE the expensive part

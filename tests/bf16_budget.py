@@ -1,0 +1,47 @@
+"""bf16-inference divergence budget for the sharded/bf16 serving rungs
+(the adam_budget.py methodology applied to the forward pass: an explicit
+amplification bound derived from the numerics, not a flat tolerance).
+
+The facts the budget is built from:
+
+1. **Cast rounding.** bfloat16 keeps 8 mantissa bits, so casting an f32
+   value to bf16 (round-to-nearest) perturbs it by at most half an ulp:
+   ``2**-9`` relative. The engine's bf16 rungs cast exactly two things
+   in-program — every float param leaf and the obs buffer — once per
+   dispatch; actions return f32 (engine.py ``_build_act``).
+2. **No accumulation growth.** XLA accumulates bf16 dot products in
+   f32 (the default ``preferred_element_type`` promotion), so a K-term
+   contraction contributes ONE rounding of each operand, not a
+   ``sqrt(K)``-growing sum-order error. The error budget is therefore
+   per-LAYER, not per-multiply-add.
+3. **Lipschitz propagation.** The policy head is a tanh-MLP: tanh is
+   1-Lipschitz and both weights and activations are O(1) at serving
+   scale (actions clip to [-1, 1]), so layer ``i`` forwards its input
+   perturbation with gain ~1 and adds its own two cast roundings
+   (weights, and the incoming activation re-rounded by the bf16
+   multiply). A depth-``D`` stack is bounded by ``(2 D + 1)`` roundings.
+4. **Measured headroom.** Observed deterministic-action divergence of
+   the bf16 512-rung vs the f32 ladder (default MLPActorCritic, this
+   container): ~8e-5 — roughly 100x inside the worst-case bound, the
+   cancellation the Lipschitz bound deliberately does not assume.
+
+So the budget for actions is ``atol = (2 * num_layers + 1) * 2**-9``
+with ``rtol = 0`` — action components are clipped O(1) quantities, so
+an absolute tolerance is the principled unit (same argument as the
+Adam budget's ``atol = lr * U``). Deterministic actions only: sampled
+actions add a bf16-rounded ``exp(log_std)`` noise scale whose budget
+would be dominated by the noise itself, and every parity gate (and the
+bench) serves deterministic.
+"""
+
+# Half-ulp relative rounding of an f32 -> bf16 cast (8 mantissa bits).
+BF16_EPS = 2.0**-9
+
+
+def bf16_action_atol(num_layers: int) -> float:
+    """Action-space budget for a depth-``num_layers`` tanh-MLP served
+    in bf16 vs f32: ``2`` cast roundings per layer (weights + incoming
+    activation) plus the obs cast, each forwarded at Lipschitz gain ~1.
+    Use with ``rtol=0`` — see the module docstring for the derivation.
+    """
+    return (2 * num_layers + 1) * BF16_EPS

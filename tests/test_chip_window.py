@@ -236,6 +236,34 @@ def test_check_bench_record_gates():
          "promotion_span_breakdown": {"gate_eval_s": -1.0}},
         [], [],
     )
+    # SLO serving fields (bench phase 9), validated whenever the
+    # req/s-at-SLO headline is present: positive rate and 512-rung
+    # percentiles, finite bf16 delta (negative legitimate on CPU),
+    # budget-1 compile receipts.
+    slo_ok = {
+        **clean,
+        "serving_req_per_sec_at_p95_slo": 462.0,
+        "serving_sharded_512_p95_ms": 27.7,
+        "serving_replicated_512_p95_ms": 57.3,
+        "serving_bf16_speedup_pct": -20.0,
+        "serving_slo_max_compiles_per_rung": 1,
+    }
+    assert check(slo_ok, [], []) == []
+    assert check({**slo_ok, "serving_req_per_sec_at_p95_slo": 0.0}, [], [])
+    assert check({**slo_ok, "serving_sharded_512_p95_ms": 0.0}, [], [])
+    assert check(
+        {**slo_ok, "serving_bf16_speedup_pct": float("nan")}, [], []
+    )
+    assert check(
+        {**slo_ok, "serving_slo_max_compiles_per_rung": 2}, [], []
+    )
+    # BENCH_SKIP_* sentinel: "skipped" in a rate field is structurally
+    # absent (no SLO validation fires), but --require rejects it with
+    # the explicit not-run reason instead of a generic type error.
+    skipped = {**clean, "serving_req_per_sec_at_p95_slo": "skipped"}
+    assert check(skipped, [], []) == []
+    problems = check(skipped, ["serving_req_per_sec_at_p95_slo"], [])
+    assert problems and "explicitly skipped" in problems[0]
 
 
 def test_partial_mirror_names_dodge_replay_glob():

@@ -786,6 +786,67 @@ FIXTURES = [
             return carry, stacked
         """,
     ),
+    (
+        # Params re-placed per request inside the serve loop: a full
+        # host->device weight upload every dispatch. The good twin
+        # places ONCE before the loop (the swap/commit seam) and
+        # dispatches against the device-resident tree.
+        "device-put-in-dispatch-loop",
+        """
+        import jax
+
+        def serve_loop(q, params, device, engine):
+            while True:
+                req = q.get()
+                placed = jax.device_put(params, device)  # per request!
+                engine.act(placed, req)
+        """,
+        """
+        import jax
+
+        def serve_loop(q, params, device, engine):
+            placed = jax.device_put(params, device)  # once, at build
+            while True:
+                req = q.get()
+                engine.act(placed, req)
+        """,
+    ),
+    (
+        # The same hazard one plain-name call hop away: the loop calls
+        # a helper that performs the placement. The good twin's helper
+        # is only called outside the loop (and an amortized batched
+        # device_get drain in the loop stays clean — gets are the
+        # runtime guard's business, per the trainer's log-interval
+        # drain idiom).
+        "device-put-in-dispatch-loop",
+        """
+        import jax
+
+        def _place(params, device):
+            return jax.device_put(params, device)
+
+        def serve_loop(q, params, device, engine):
+            while not q.empty():
+                req = q.get()
+                engine.act(_place(params, device), req)
+        """,
+        """
+        import jax
+
+        def _place(params, device):
+            return jax.device_put(params, device)
+
+        def serve_loop(q, params, device, engine, metrics):
+            placed = _place(params, device)
+            i = 0
+            while not q.empty():
+                req = q.get()
+                engine.act(placed, req)
+                i += 1
+                if i % 100 == 0:
+                    jax.device_get(metrics)  # amortized drain: clean
+        """,
+    ),
 ]
 
 

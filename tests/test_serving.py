@@ -553,7 +553,7 @@ def test_client_retries_backpressure_delivered_through_the_future():
             self.trace_ids = []
 
         def submit(self, obs, deterministic=True, timeout_s=None,
-                   trace_id=None):
+                   trace_id=None, slo_class="interactive"):
             self.calls += 1
             self.trace_ids.append(trace_id)
             future = Future()
