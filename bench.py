@@ -47,7 +47,15 @@ Measures, inside one process and one JSON line:
   (serving/loadgen.py bisection) on the full sharded+bf16 fleet, with
   ``serving_sharded_512_p95_ms`` vs ``serving_replicated_512_p95_ms``
   (same trace, with/without the mesh-backed big-rung slice) and
-  ``serving_bf16_speedup_pct`` beside it. Phases skipped via
+  ``serving_bf16_speedup_pct`` beside it.
+- ``adversarial_candidates_per_sec``: the falsifier-search throughput
+  (scenarios/adversary.py — one vmapped compiled eval per generation,
+  ``adversarial_search_compiles`` == 1 across all generations and both
+  trained policies) and ``worst_case_return_gap_pct``: the
+  auto-curriculum payoff — curriculum-trained vs clean-trained return
+  at the discovered worst cases, equal training steps.
+
+Phases skipped via
   ``BENCH_SKIP_*`` env vars record the explicit ``"skipped"`` sentinel
   in their rate fields plus a ``phases_skipped`` list, so "not run"
   never reads as "regressed to absent".
@@ -74,7 +82,8 @@ BENCH_FORCE_CPU=1, BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1,
 BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1, BENCH_SKIP_SERVING=1,
 BENCH_SERVING_DURATION_S, BENCH_SKIP_PIPELINE=1, BENCH_PIPELINE_M,
 BENCH_PIPELINE_GATE_M, BENCH_PIPELINE_BUDGET_S, BENCH_SLO_DURATION_S,
-BENCH_SLO_P95_MS.
+BENCH_SLO_P95_MS, BENCH_SKIP_ADVERSARIAL=1, BENCH_ADV_M,
+BENCH_ADV_ITERS, BENCH_ADV_EVAL_M.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -1439,6 +1448,164 @@ def main() -> None:
                     notes.append(f"serving slo phase failed: {e!r}"[:200])
             else:
                 notes.append("serving slo phase skipped: deadline")
+        # Phase 10 — adversarial robustness (scenarios/adversary.py,
+        # docs/adversarial.md): the falsifier search throughput + its
+        # budget-1 compile receipt, and the auto-curriculum payoff at
+        # EQUAL training steps — two tiny policies from the same seed,
+        # one trained clean throughout, one switched mid-run to the
+        # from_falsifiers stage discovered by searching its own
+        # half-trained params (the train -> search -> train loop the
+        # gate automates). worst_case_return_gap_pct is the
+        # curriculum-trained policy's relative improvement over the
+        # clean-trained one at the clean policy's discovered worst
+        # cases (positive = adversarial training helped); honest noise
+        # caveat: at bench-sized budgets this is directional, and it is
+        # recorded whatever its sign.
+        if os.environ.get("BENCH_SKIP_ADVERSARIAL") == "1":
+            _mark_skipped(
+                result,
+                "adversarial",
+                (
+                    "adversarial_candidates_per_sec",
+                    "adversarial_search_compiles",
+                    "worst_case_return_gap_pct",
+                ),
+            )
+        else:
+            if time.time() < deadline - 60:
+                try:
+                    from marl_distributedformation_tpu.algo import PPOConfig
+                    from marl_distributedformation_tpu.scenarios import (
+                        AdversaryConfig,
+                        AdversarySearch,
+                        ScenarioSchedule,
+                        ScenarioStage,
+                        from_falsifiers,
+                    )
+                    from marl_distributedformation_tpu.train import (
+                        TrainConfig,
+                        Trainer,
+                    )
+
+                    adv_env = EnvParams(num_agents=4, max_steps=60)
+                    adv_m = _env_int("BENCH_ADV_M", 16)
+                    adv_iters = _env_int("BENCH_ADV_ITERS", 24)
+                    adv_ppo = PPOConfig(
+                        n_steps=5, n_epochs=2, batch_size=64
+                    )
+                    per_iter = adv_ppo.n_steps * adv_m * adv_env.num_agents
+                    clean_sched = ScenarioSchedule(stages=(ScenarioStage(
+                        rollouts=1, scenarios=("clean",),
+                        severity=0.0, severity_start=0.0,
+                    ),))
+
+                    def adv_trainer(name):
+                        return Trainer(
+                            adv_env,
+                            ppo=adv_ppo,
+                            config=TrainConfig(
+                                num_formations=adv_m,
+                                total_timesteps=adv_iters * per_iter,
+                                checkpoint=False,
+                                name=name,
+                                log_dir=f"/tmp/bench_{name}",
+                                seed=0,
+                            ),
+                            scenario_schedule=clean_sched,
+                        )
+
+                    clean_tr = adv_trainer("adv_clean")
+                    curr_tr = adv_trainer("adv_curriculum")
+                    # Same searcher (ONE compiled population program)
+                    # serves the mid-run search, the final search, and
+                    # the worst-case comparison cells.
+                    search = AdversarySearch(
+                        clean_tr.model,
+                        adv_env,
+                        AdversaryConfig(
+                            scenarios=("wind", "sensor_noise",
+                                       "actuator_noise"),
+                            grid=4,
+                            generations=3,
+                            num_formations=_env_int("BENCH_ADV_EVAL_M", 16),
+                            drop_tolerance=0.1,
+                        ),
+                    )
+                    half = adv_iters // 2
+                    for _ in range(half):
+                        clean_tr.run_iteration()
+                        curr_tr.run_iteration()
+                    mid = search.search(
+                        curr_tr.train_state.params, origin="half-trained"
+                    )
+                    if mid["falsifiers"]:
+                        curr_tr.update_scenario_schedule(from_falsifiers(
+                            mid["falsifiers"], rollouts=adv_iters - half,
+                        ))
+                    for _ in range(adv_iters - half):
+                        clean_tr.run_iteration()
+                        curr_tr.run_iteration()
+                    # The recorded search: the CLEAN-trained policy's
+                    # falsifiers (timed; candidates/sec headline).
+                    final = search.search(
+                        clean_tr.train_state.params, origin="clean-trained"
+                    )
+                    cells = [
+                        (f["scenario"], f["severity"])
+                        for f in final["falsifiers"]
+                    ] or [
+                        (name, search.config.max_severity)
+                        for name in final["scenarios"]
+                    ]
+                    wc_clean = min(search.evaluate_cells(
+                        clean_tr.train_state.params, cells,
+                        origin="clean-trained",
+                    ))
+                    wc_curr = min(search.evaluate_cells(
+                        curr_tr.train_state.params, cells,
+                        origin="curriculum-trained",
+                    ))
+                    gap = (
+                        100.0 * (wc_curr - wc_clean)
+                        / max(abs(wc_clean), 1.0)
+                    )
+                    result["adversarial_candidates_per_sec"] = round(
+                        search.candidates_per_sec(), 1
+                    )
+                    result["adversarial_search_compiles"] = (
+                        search.compile_count
+                    )
+                    result["adversarial_search_generations"] = (
+                        final["generations"]
+                    )
+                    result["adversarial_falsifiers"] = {
+                        f["scenario"]: f["severity"]
+                        for f in final["falsifiers"]
+                    }
+                    result["worst_case_return_gap_pct"] = round(gap, 2)
+                    result["worst_case_return_clean_trained"] = round(
+                        wc_clean, 2
+                    )
+                    result["worst_case_return_curriculum_trained"] = round(
+                        wc_curr, 2
+                    )
+                    result["adversarial_train_timesteps"] = (
+                        adv_iters * per_iter
+                    )
+                    print(
+                        "[bench] adversarial (search + auto-curriculum, "
+                        f"{adv_iters} iters each): "
+                        f"{result['adversarial_candidates_per_sec']:,.0f} "
+                        f"candidates/s ({search.compile_count} compile), "
+                        f"worst-case return {wc_clean:,.0f} clean-trained "
+                        f"vs {wc_curr:,.0f} curriculum-trained "
+                        f"({gap:+.1f}%)",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade, don't die
+                    notes.append(f"adversarial phase failed: {e!r}"[:200])
+            else:
+                notes.append("adversarial phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

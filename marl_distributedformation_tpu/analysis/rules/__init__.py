@@ -32,6 +32,9 @@ from marl_distributedformation_tpu.analysis.rules.prng import PrngKeyReuse
 from marl_distributedformation_tpu.analysis.rules.scan_carry import (
     ScanCarryWeakType,
 )
+from marl_distributedformation_tpu.analysis.rules.search_compare import (
+    TracedComparisonInSearch,
+)
 from marl_distributedformation_tpu.analysis.rules.sharding_drift import (
     ScanCarryShardingDrift,
 )
@@ -59,6 +62,7 @@ RULES = (
     CrossModuleCallback(),
     SpanInTracedScope(),
     DevicePutInDispatchLoop(),
+    TracedComparisonInSearch(),
 )
 
 

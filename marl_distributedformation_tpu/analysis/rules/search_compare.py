@@ -1,0 +1,130 @@
+"""traced-python-comparison-in-search: fitness branching under trace.
+
+The evolutionary-search foot-gun: a search loop written as (or fused
+into) a traced program — a ``lax.while_loop`` / ``fori_loop`` / ``scan``
+body, or a Python generation loop inside a jitted function — selects
+candidates by COMPARING traced fitness/severity values, and the natural
+spelling is a little helper::
+
+    def better(best, cand):
+        if cand > best:      # ConcretizationTypeError under trace
+            return cand
+        return best
+
+Rule 2 (``traced-python-control-flow``) catches a comparison branch
+written DIRECTLY in the traced body, but the helper above lives at
+module level: it is not itself traced, so rule 2 never walks it — the
+error only surfaces at trace time, one call hop away from the loop that
+caused it. This rule extends detection that one hop (the rules 12/14/16
+reachability precedent): a plain-name call inside a traced search-loop
+body is followed into its same-module definition, and a Python
+``if``/``while`` there whose test compares the helper's (presumed
+traced) parameters is reported at the CALL site. The fix is the same as
+rule 2's: ``jnp.where`` / ``lax.cond`` keep the selection inside the
+compiled program.
+
+Scope, deliberately: loop bodies only — a helper called from straight-
+line traced code is still a latent bug, but the search-loop shape is
+where evolutionary code actually puts selection, and bounding the scope
+keeps the false-positive surface small (helpers comparing static config
+are already filtered by the taint engine's static-parameter rules).
+Host-side search loops (this repo's ``AdversarySearch``) drain fitness
+to numpy before comparing and stay clean. Deeper call chains, method
+calls, and cross-module helpers are left to the trace-time error
+itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    TRACING_ENTRY_ARGS,
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+# Tracing entry points whose traced callables are LOOP BODIES — the
+# search-loop shapes (cond fns included: a while_loop condition that
+# compares through a branching helper concretizes identically).
+_LOOP_ENTRIES = frozenset(
+    name
+    for name in TRACING_ENTRY_ARGS
+    if name.rsplit(".", 1)[-1] in {"while_loop", "fori_loop", "scan", "map"}
+)
+
+
+class TracedComparisonInSearch(Rule):
+    name = "traced-python-comparison-in-search"
+    default_severity = "error"
+    description = (
+        "a traced search loop body calls a helper that Python-branches "
+        "on a comparison of its (traced) arguments — concretizes at "
+        "trace time; select with jnp.where / lax.cond instead"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        reported: Set[Tuple[int, int]] = set()
+        for site in self._search_sites(ctx):
+            for node in ast.walk(site):
+                if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Name
+                ):
+                    continue
+                hit = self._branching_comparison_in(ctx, node.func.id)
+                if hit is None:
+                    continue
+                helper, line = hit
+                if (node.lineno, node.col_offset) in reported:
+                    continue
+                reported.add((node.lineno, node.col_offset))
+                yield (
+                    node.lineno,
+                    node.col_offset,
+                    f"{node.func.id}() is called from a traced search "
+                    f"loop and Python-branches on a comparison of its "
+                    f"arguments (line {line}) — a ConcretizationTypeError "
+                    "at trace time; return jnp.where(cmp, a, b) or use "
+                    "lax.cond so the selection stays in the program",
+                )
+
+    def _search_sites(self, ctx: ModuleContext) -> List[ast.AST]:
+        """AST subtrees that are traced search-loop bodies: callables
+        handed to lax loop entries, plus host ``for``/``while`` loops
+        jitted wholesale inside any traced scope."""
+        sites: List[ast.AST] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname in _LOOP_ENTRIES:
+                    for pos in TRACING_ENTRY_ARGS[fname]:
+                        if pos < len(node.args):
+                            sites.extend(
+                                ctx._resolve_callable(node.args[pos])
+                            )
+            elif isinstance(node, (ast.For, ast.While)):
+                if ctx._has_traced_ancestor(node):
+                    sites.append(node)
+        return sites
+
+    def _branching_comparison_in(
+        self, ctx: ModuleContext, name: str
+    ) -> Optional[Tuple[str, int]]:
+        """Does the same-module helper ``name`` branch on a comparison
+        of its presumed-traced parameters? Helpers that are themselves
+        traced scopes are rule 2's report, not a second one here."""
+        for helper in ctx._defs_by_name.get(name, ()):
+            if helper in ctx.traced_scopes:
+                continue
+            taint = ctx._param_names(helper)
+            for node in ast.walk(helper):
+                if not isinstance(node, (ast.If, ast.IfExp, ast.While)):
+                    continue
+                for cmp_node in ast.walk(node.test):
+                    if isinstance(
+                        cmp_node, ast.Compare
+                    ) and ctx.expr_tainted(cmp_node, taint):
+                        return helper.name, node.lineno
+        return None

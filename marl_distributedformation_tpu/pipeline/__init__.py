@@ -37,6 +37,7 @@ from marl_distributedformation_tpu.pipeline.gate import (  # noqa: F401
     GateVerdict,
     PromotionGate,
     judge_candidate,
+    judge_falsifiers,
 )
 from marl_distributedformation_tpu.pipeline.promote import (  # noqa: F401
     PromotionLog,
@@ -61,4 +62,5 @@ __all__ = [
     "Promoter",
     "RollbackMonitor",
     "judge_candidate",
+    "judge_falsifiers",
 ]

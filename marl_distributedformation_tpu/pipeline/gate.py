@@ -45,7 +45,19 @@ Cells = Dict[str, Dict[str, Dict[str, float]]]
 
 @dataclasses.dataclass(frozen=True)
 class GateConfig:
-    """What the gate evaluates and how much regression it tolerates."""
+    """What the gate evaluates and how much regression it tolerates.
+
+    ``adversarial=True`` adds the worst-case rung: every candidate also
+    runs the falsifier search (``scenarios.adversary.AdversarySearch`` —
+    one more compiled program, built once, budget-1 across all
+    candidates), and a falsifier discovered BELOW
+    ``adversarial_min_severity`` is a rejection carrying the falsifier's
+    concrete params in the verdict — the supervisor feeds those back
+    into the trainer's schedule (docs/adversarial.md). Unlike the
+    matrix rungs this is an ABSOLUTE floor, not a baseline regression:
+    "must survive every family up to severity S" is the robustness
+    contract a served policy owes, whoever served before it.
+    """
 
     scenarios: Tuple[str, ...] = ("wind", "sensor_noise")
     severities: Tuple[float, ...] = (0.5, 1.0)
@@ -55,11 +67,27 @@ class GateConfig:
     metric: str = "episode_return_per_agent"
     clean_tolerance: float = 0.05  # relative clean-return slack vs served
     rung_tolerance: float = 0.10  # relative per-cell slack vs served
+    # -- adversarial rung (off by default: it costs a second compiled
+    # program and generations x population eval cells per candidate) --
+    adversarial: bool = False
+    adversarial_scenarios: Tuple[str, ...] = ()  # () -> `scenarios`
+    adversarial_min_severity: float = 0.5  # falsifier below this rejects
+    adversarial_drop_tolerance: float = 0.2
+    adversarial_max_severity: float = 1.5
+    adversarial_grid: int = 4
+    adversarial_generations: int = 3
+    adversarial_formations: int = 64
 
 
 @dataclasses.dataclass
 class GateVerdict:
-    """One candidate's judgment — everything ``promotions.jsonl`` needs."""
+    """One candidate's judgment — everything ``promotions.jsonl`` needs.
+
+    ``falsifiers`` is None when the adversarial rung did not run, else
+    the search's ``Falsifier.record()`` list (possibly empty) — so a
+    rejection carries the exact disturbance params that broke the
+    candidate, ready for ``scenarios.from_falsifiers`` (promotions.jsonl
+    schema 3)."""
 
     step: int
     path: str
@@ -70,11 +98,13 @@ class GateVerdict:
     baseline_step: Optional[int]
     eval_compiles: int
     eval_seconds: float
+    falsifiers: Optional[List[dict]] = None
+    adversary_compiles: int = 0
 
     def record(self) -> dict:
         """The flat payload logged per candidate (PromotionLog adds
         schema/event/time)."""
-        return {
+        out = {
             "step": self.step,
             "checkpoint": self.path,
             "passed": self.passed,
@@ -85,6 +115,10 @@ class GateVerdict:
             "gate_eval_compiles": self.eval_compiles,
             "gate_eval_seconds": round(self.eval_seconds, 4),
         }
+        if self.falsifiers is not None:
+            out["falsifiers"] = list(self.falsifiers)
+            out["gate_adversary_compiles"] = self.adversary_compiles
+        return out
 
 
 def _relative_regression(candidate: float, baseline: float) -> float:
@@ -158,6 +192,26 @@ def judge_candidate(
     return reasons
 
 
+def judge_falsifiers(
+    falsifiers: List[dict], min_severity: float, metric: str
+) -> List[str]:
+    """Pure adversarial-rung verdict: rejection reasons for falsifiers
+    below the severity floor (empty = the candidate survives every
+    searched family up to the floor). Unit-testable without an eval,
+    like :func:`judge_candidate`."""
+    reasons: List[str] = []
+    for falsifier in falsifiers:
+        severity = float(falsifier.get("severity", math.nan))
+        if not math.isfinite(severity) or severity < min_severity:
+            drop = float(falsifier.get("drop", math.nan))
+            reasons.append(
+                f"adversarial falsifier {falsifier.get('scenario')}"
+                f"@{severity:g}: {metric} drops {drop * 100.0:.1f}% vs "
+                f"clean below the severity floor {min_severity:g}"
+            )
+    return reasons
+
+
 class PromotionGate:
     """Judge candidates against the served baseline with one compiled
     eval program.
@@ -174,6 +228,7 @@ class PromotionGate:
         self.env_params = env_params
         self.config = config
         self.program = None  # scenarios.matrix.MatrixProgram, lazy
+        self.adversary = None  # scenarios.adversary.AdversarySearch, lazy
         self._baseline_step: Optional[int] = None
         self._baseline_clean: Optional[Dict[str, float]] = None
         self._baseline_cells: Optional[Cells] = None
@@ -254,6 +309,42 @@ class PromotionGate:
                     pol.params, cfg.scenarios, cfg.severities,
                     origin=str(path),
                 )
+            falsifiers = None
+            if cfg.adversarial:
+                # The adversarial rung: its OWN compiled population
+                # program (a different shape than the matrix runner's),
+                # built once from the first candidate and budget-1
+                # across every later one, like the matrix itself.
+                if self.adversary is None:
+                    from marl_distributedformation_tpu.scenarios import (
+                        AdversaryConfig,
+                        AdversarySearch,
+                    )
+
+                    self.adversary = AdversarySearch(
+                        pol.model,
+                        self.env_params,
+                        AdversaryConfig(
+                            scenarios=(
+                                cfg.adversarial_scenarios or cfg.scenarios
+                            ),
+                            metric=cfg.metric,
+                            drop_tolerance=cfg.adversarial_drop_tolerance,
+                            max_severity=cfg.adversarial_max_severity,
+                            grid=cfg.adversarial_grid,
+                            generations=cfg.adversarial_generations,
+                            num_formations=cfg.adversarial_formations,
+                            seed=cfg.eval_seed,
+                            deterministic=cfg.deterministic,
+                        ),
+                    )
+                with get_tracer().span(
+                    "gate.adversary_search", trace_id=trace_id, step=step,
+                ):
+                    search_report = self.adversary.search(
+                        pol.params, origin=str(path)
+                    )
+                falsifiers = search_report["falsifiers"]
         except Exception as e:  # noqa: BLE001 — a bad candidate must
             # never kill the pipeline; it is a rejected verdict.
             return GateVerdict(
@@ -281,6 +372,12 @@ class PromotionGate:
             cfg.clean_tolerance,
             cfg.rung_tolerance,
         )
+        if falsifiers is not None:
+            reasons.extend(
+                judge_falsifiers(
+                    falsifiers, cfg.adversarial_min_severity, cfg.metric
+                )
+            )
         return GateVerdict(
             step=step,
             path=str(path),
@@ -291,6 +388,10 @@ class PromotionGate:
             baseline_step=self._baseline_step,
             eval_compiles=self.program.compile_count,
             eval_seconds=seconds,
+            falsifiers=falsifiers,
+            adversary_compiles=(
+                self.adversary.compile_count if self.adversary else 0
+            ),
         )
 
     # -- baseline management ---------------------------------------------

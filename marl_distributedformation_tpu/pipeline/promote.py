@@ -39,17 +39,28 @@ from marl_distributedformation_tpu.utils.checkpoint import checkpoint_step
 #       (``stream_poll_s`` / ``gate_eval_s`` / ``publish_s`` /
 #       ``barrier_commit_s`` / ``first_serve_s`` [+ ``deferred_wait_s``])
 #       whose values sum to ``promotion_latency_s`` (within clock skew).
-PROMOTIONS_SCHEMA = 2
+#   3 — adversarial gate rung (scenarios/adversary.py): when the rung
+#       ran, verdict lines carry ``falsifiers`` (the search's
+#       ``Falsifier.record()`` list — scenario, minimal severity, drop
+#       vs clean, and the concrete ScenarioParams knob dict) plus
+#       ``gate_adversary_compiles`` (the search program's budget-1
+#       receipt); new event ``curriculum_updated`` records the
+#       supervisor feeding a rejection's falsifiers back into the
+#       trainer's schedule (and ``curriculum_update_failed`` when the
+#       trainer has no scenario seam to feed).
+PROMOTIONS_SCHEMA = 3
 
-# Schemas the reader accepts. Schema-1 lines (pre-obs runs) stay
-# readable forever: the reader backfills ``trace_id``/``spans`` as None.
-READABLE_SCHEMAS = (1, 2)
+# Schemas the reader accepts. Older lines stay readable forever: the
+# reader backfills ``trace_id``/``spans`` (schema 2) and ``falsifiers``
+# (schema 3) as None.
+READABLE_SCHEMAS = (1, 2, 3)
 
 
 class PromotionLog:
     """Append-only JSONL verdict log. Every line carries ``schema``,
-    ``event`` (``promoted`` / ``rejected`` / ``rolled_back``), and
-    ``time`` (epoch seconds); the rest is the event's payload."""
+    ``event`` (``promoted`` / ``rejected`` / ``rolled_back`` /
+    ``curriculum_updated`` / ...), and ``time`` (epoch seconds); the
+    rest is the event's payload."""
 
     def __init__(self, path: str | Path) -> None:
         self.path = Path(path)
@@ -95,6 +106,10 @@ class PromotionLog:
             if schema < 2:
                 rec.setdefault("trace_id", None)
                 rec.setdefault("spans", None)
+            # Unconditional: schema-3 lines carry `falsifiers` only when
+            # the adversarial rung RAN — readers get None, never a
+            # KeyError, whichever way the gate was configured.
+            rec.setdefault("falsifiers", None)
             records.append(rec)
         return records
 

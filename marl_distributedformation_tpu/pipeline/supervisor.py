@@ -104,6 +104,7 @@ class AlwaysLearningPipeline:
         promoted_dir: Optional[str | Path] = None,
         poll_interval_s: float = 0.25,
         start_after_step: int = -1,
+        feedback_rollouts: int = 50,
     ) -> None:
         self.log_dir = Path(log_dir)
         self.env_params = env_params  # sized requests (first-serve probe)
@@ -122,6 +123,13 @@ class AlwaysLearningPipeline:
         self.router: Optional[Any] = None
         self.coordinator: Optional[Any] = None
         self.monitor: Optional[RollbackMonitor] = None
+        self.trainer: Optional[Any] = None
+        # Auto-curriculum feedback (docs/adversarial.md): rejections
+        # whose verdict carries falsifiers are fed back into an attached
+        # trainer's scenario schedule as a from_falsifiers stage of this
+        # many rollouts.
+        self.feedback_rollouts = int(feedback_rollouts)
+        self.curriculum_updates = 0
         self.promotions: List[PromotionRecord] = []
         self.rejections: List[GateVerdict] = []
         self.rollbacks: List[dict] = []
@@ -166,8 +174,11 @@ class AlwaysLearningPipeline:
     def attach_trainer(self, trainer: Any) -> None:
         """Push-path hookup: the trainer nudges the stream the moment a
         checkpoint is durable (no poll-interval floor on promotion
-        latency)."""
+        latency) — and, with the gate's adversarial rung on, receives
+        rejected candidates' falsifiers back as curriculum stages (the
+        train -> gate -> train robustness loop)."""
         trainer.on_checkpoint = self.stream.nudge
+        self.trainer = trainer
 
     # -- the loop --------------------------------------------------------
 
@@ -206,6 +217,7 @@ class AlwaysLearningPipeline:
             self.log.append(
                 "rejected", **verdict.record(), trace_id=tr.trace_id
             )
+            self._feed_falsifiers(verdict, tr.trace_id)
             return verdict
         t0 = time.perf_counter()
         with tracer.span(
@@ -246,6 +258,46 @@ class AlwaysLearningPipeline:
             latency = None
         self._finalize_promotion(verdict, str(promoted), path, latency, tr)
         return verdict
+
+    def _feed_falsifiers(
+        self, verdict: GateVerdict, trace_id: Optional[str]
+    ) -> None:
+        """Close the train -> gate -> train loop: a rejection that
+        carries discovered falsifiers becomes a new curriculum stage in
+        the attached trainer (``scenarios.from_falsifiers``, applied by
+        the training thread at its next dispatch boundary). Audit-logged
+        as ``curriculum_updated`` with the falsifier payloads — the
+        schedule the trainer runs is reconstructible from the log. A
+        trainer without the scenario seam degrades to a logged
+        ``curriculum_update_failed``, never a crashed control plane."""
+        falsifiers = getattr(verdict, "falsifiers", None) or []
+        if self.trainer is None or not falsifiers:
+            return
+        from marl_distributedformation_tpu.scenarios import from_falsifiers
+
+        try:
+            schedule = from_falsifiers(
+                falsifiers, rollouts=self.feedback_rollouts
+            )
+            self.trainer.request_scenario_schedule(schedule)
+        except Exception as e:  # noqa: BLE001 — feedback is advisory;
+            # a mis-wired trainer must not kill the promotion loop.
+            self.log.append(
+                "curriculum_update_failed",
+                step=verdict.step,
+                reason=repr(e)[:300],
+                trace_id=trace_id,
+            )
+            return
+        self.curriculum_updates += 1
+        self.log.append(
+            "curriculum_updated",
+            step=verdict.step,
+            falsifiers=list(falsifiers),
+            feedback_rollouts=self.feedback_rollouts,
+            scenarios=list(schedule.names),
+            trace_id=trace_id,
+        )
 
     def _probe_first_serve(self, tr: _PromotionTrace, step: int) -> None:
         """Witness the first post-commit response at the promoted step:
@@ -550,6 +602,7 @@ class AlwaysLearningPipeline:
             "promotions": len(self.promotions),
             "rejections": len(self.rejections),
             "rollbacks": len(self.rollbacks),
+            "curriculum_updates": self.curriculum_updates,
             "deferred_promotions": len(self._deferred),
             "pipeline_errors": list(self.errors),
             "served_step": (

@@ -33,12 +33,20 @@ from marl_distributedformation_tpu.scenarios.registry import (  # noqa: F401
     scenario_params_for,
 )
 from marl_distributedformation_tpu.scenarios.schedule import (  # noqa: F401
+    ADV_SCENARIO_PREFIX,
     ScenarioSchedule,
     ScenarioStage,
+    from_falsifiers,
     schedule_from_cfg,
 )
 from marl_distributedformation_tpu.scenarios.matrix import (  # noqa: F401
     MatrixProgram,
     make_matrix_runner,
     run_matrix,
+)
+from marl_distributedformation_tpu.scenarios.adversary import (  # noqa: F401
+    AdversaryConfig,
+    AdversarySearch,
+    Falsifier,
+    make_population_runner,
 )

@@ -18,10 +18,35 @@ from typing import Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from marl_distributedformation_tpu.scenarios.params import ScenarioParams
 
 Array = jax.Array
+
+
+def _validate_severity(severity, where: str) -> None:
+    """Fail fast on a concrete severity that is negative or non-finite —
+    a negative severity would silently FLIP every perturbation sign
+    through the linear magnitude scaling (wind blowing backwards is a
+    different scenario, not a milder one), and NaN/inf poisons every
+    downstream cell. Traced severities (inside a jitted sampler/step)
+    skip the check: values are unknowable at trace time, and every
+    host-side entry into the traced path runs through here first."""
+    try:
+        value = np.asarray(severity)
+    except Exception:  # noqa: BLE001 — a tracer: jit-time, concrete
+        return  # values validated at the host-side call sites
+    if not np.all(np.isfinite(value)):
+        raise ValueError(
+            f"{where}: severity must be finite, got {value!r}"
+        )
+    if np.any(value < 0.0):
+        raise ValueError(
+            f"{where}: severity must be >= 0, got {value!r} — a negative "
+            "severity flips perturbation signs via the linear magnitude "
+            "scaling instead of weakening them"
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,7 +73,11 @@ class ScenarioSpec:
 
     def build(self, severity) -> ScenarioParams:
         """Scale the severity-1 magnitudes by a traced ``severity``
-        (probabilities clipped to [0, 1])."""
+        (probabilities clipped to [0, 1]). A concrete severity that is
+        negative or non-finite raises a clean ValueError naming the
+        scenario (traced severities are validated at their host-side
+        entry points instead)."""
+        _validate_severity(severity, f"scenario {self.name!r}")
         s = jnp.asarray(severity, jnp.float32)
 
         def scaled(base: float) -> Array:
@@ -179,8 +208,14 @@ def sample_scenario_batch(
     subset is zeros elsewhere), ``severity`` a traced scalar — so a jitted
     sampler over a fixed spec union never retraces across stages or
     severity schedules. Returns ``ScenarioParams`` with a leading ``(M,)``
-    axis on every leaf.
+    axis on every leaf. A concrete negative / non-finite severity fails
+    fast naming the spec set (the traced path validates at its host-side
+    entry instead).
     """
+    _validate_severity(
+        severity,
+        f"scenario batch over [{', '.join(s.name for s in specs)}]",
+    )
     stacked = jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves),
         *[spec.build(severity) for spec in specs],

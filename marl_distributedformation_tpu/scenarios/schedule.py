@@ -22,11 +22,16 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, List, Optional, Tuple
+import math
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from marl_distributedformation_tpu.scenarios.registry import get_scenario
+from marl_distributedformation_tpu.scenarios.registry import (
+    ScenarioSpec,
+    get_scenario,
+    register_scenario,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,6 +202,90 @@ class ScenarioSchedule:
         stage walk."""
         _, _, _, _, probs = self._stage_table
         return probs[self._stage_indices(rollout, k)]
+
+
+# Derived adversarial-spec naming: one STABLE name per attacked family,
+# so repeated falsifier feedback for the same scenario overwrites the
+# spec in place (the schedule's name union — and with it the trainer's
+# jitted sampler axis — never grows across feedback rounds).
+ADV_SCENARIO_PREFIX = "adv:"
+
+
+def from_falsifiers(
+    falsifiers: Sequence[Any],
+    rollouts: int = 100,
+    include_clean: bool = True,
+    severity_scale: float = 1.0,
+) -> ScenarioSchedule:
+    """Turn discovered worst cases into an auto-curriculum stage.
+
+    ``falsifiers`` are ``adversary.Falsifier`` objects or their
+    ``record()`` dicts (anything with ``scenario`` + ``severity`` — the
+    gate's verdict payload round-trips). Each one registers a derived
+    spec ``adv:{scenario}`` whose severity-1 magnitudes are the base
+    family's scaled to the falsifier severity (times
+    ``severity_scale``), so the returned single-stage schedule trains a
+    uniform mix of every falsifier AT its discovered break point
+    (severity 1.0, flat — each family at its own magnitudes, which one
+    shared stage severity could not express). ``include_clean`` keeps
+    the identity scenario in the mix: pure worst-case training forgets
+    the clean task (the auto-curriculum retention trade, JaxMARL /
+    Jumanji idiom — docs/adversarial.md).
+
+    Consumed by the existing trainer via
+    ``Trainer.update_scenario_schedule`` /
+    ``request_scenario_schedule``: stage data and spec magnitudes are
+    values, so the compiled train step never recompiles — see there.
+    """
+    if not falsifiers:
+        raise ValueError("from_falsifiers needs at least one falsifier")
+    names: List[str] = []
+    magnitude_fields = [
+        f.name
+        for f in dataclasses.fields(ScenarioSpec)
+        if f.name not in ("name", "description")
+    ]
+    for falsifier in falsifiers:
+        if isinstance(falsifier, dict):
+            scenario = str(falsifier["scenario"])
+            severity = falsifier["severity"]
+        else:
+            scenario = str(falsifier.scenario)
+            severity = falsifier.severity
+        severity = float(severity) * float(severity_scale)
+        if not math.isfinite(severity) or severity <= 0.0:
+            raise ValueError(
+                f"falsifier for scenario {scenario!r} has severity "
+                f"{severity!r}; a training stage needs a finite positive "
+                "severity (severity 0 is the clean env by construction)"
+            )
+        base = get_scenario(scenario)  # fail fast on unknown families
+        derived = ScenarioSpec(
+            name=f"{ADV_SCENARIO_PREFIX}{scenario}",
+            description=(
+                f"adversarial curriculum: {scenario} at discovered "
+                f"falsifier severity {severity:g}"
+            ),
+            **{
+                field: getattr(base, field) * severity
+                for field in magnitude_fields
+            },
+        )
+        register_scenario(derived, overwrite=True)
+        if derived.name not in names:
+            names.append(derived.name)
+    if include_clean:
+        names.append("clean")
+    return ScenarioSchedule(
+        stages=(
+            ScenarioStage(
+                rollouts=int(rollouts),
+                scenarios=tuple(names),
+                severity=1.0,
+                severity_start=1.0,
+            ),
+        )
+    )
 
 
 def schedule_from_cfg(

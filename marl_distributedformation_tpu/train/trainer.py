@@ -16,6 +16,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -385,6 +386,16 @@ class Trainer:
         self._scenario_step_fn = None
         self.scenario_params = None
         self.scenario_severity = 0.0
+        # Per-iteration severities of the most recent chunked dispatch
+        # (what the fused driver logs) — written by _next_scenario_chunk.
+        self._last_chunk_severities = None
+        # Auto-curriculum seam (scenarios/adversary.py, docs/adversarial.md):
+        # a schedule handed to request_scenario_schedule() from another
+        # thread (the pipeline supervisor feeding gate falsifiers back)
+        # is applied at the next dispatch boundary — the only place the
+        # training thread touches schedule state.
+        self._pending_schedule: Any = None
+        self._schedule_lock = threading.Lock()
         if scenario_schedule is not None:
             if self._env_step_fn is not None:
                 # Which specialized step blocked it matters for the fix:
@@ -411,36 +422,13 @@ class Trainer:
             from marl_distributedformation_tpu.scenarios import (
                 get_scenario,
                 make_scenario_step,
-                sample_scenario_batch,
             )
 
             self._scenario_specs = tuple(
                 get_scenario(n) for n in scenario_schedule.names
             )
             self._scenario_step_fn = make_scenario_step(env_params)
-            # One jitted sampler over the schedule's fixed scenario union:
-            # stage changes move probability mass, severity ramps scale
-            # magnitudes — both traced, so the sampler compiles once too.
-            self._sample_scenarios = jax.jit(
-                functools.partial(
-                    sample_scenario_batch,
-                    specs=self._scenario_specs,
-                    num_formations=config.num_formations,
-                )
-            )
-            # Chunked twin: ONE jitted pass draws the per-iteration param
-            # batches for a whole fused chunk (leading (k,) axis over
-            # keys/severities/probs — all data, so this compiles once per
-            # chunk size and never retraces across stages or ramps).
-            self._sample_scenario_chunk = jax.jit(
-                jax.vmap(
-                    functools.partial(
-                        sample_scenario_batch,
-                        specs=self._scenario_specs,
-                        num_formations=config.num_formations,
-                    )
-                )
-            )
+            self._build_scenario_samplers()
             # Base key for the sampling stream; per-dispatch keys fold in
             # the global rollout index, so the stream is a pure function
             # of (seed, rollout) and resume continues it exactly instead
@@ -449,6 +437,13 @@ class Trainer:
                 jax.random.PRNGKey(config.seed), 0x5CE7
             )
             self._scenario_rollouts = 0
+            # The key stream folds this GLOBAL draw counter, not the
+            # schedule-relative rollout index: a curriculum swap resets
+            # the schedule position but must never replay early-run
+            # sampling keys. Identical to _scenario_rollouts until the
+            # first update_scenario_schedule (bitwise parity with the
+            # pre-feedback behavior, incl. fused==host pins).
+            self._scenario_draws = 0
             self._resample_scenario_params()
 
         self.num_timesteps = 0
@@ -522,6 +517,100 @@ class Trainer:
             self._scenario_step_fn,
         )
 
+    def _build_scenario_samplers(self) -> None:
+        """(Re)build the jitted domain-randomization samplers over the
+        schedule's CURRENT spec union: stage changes move probability
+        mass, severity ramps scale magnitudes — both traced, so each
+        sampler compiles once per spec union. The chunked twin draws a
+        whole fused chunk's per-iteration batches in one pass (leading
+        (k,) axis over keys/severities/probs)."""
+        from marl_distributedformation_tpu.scenarios import (
+            sample_scenario_batch,
+        )
+
+        self._sample_scenarios = jax.jit(
+            functools.partial(
+                sample_scenario_batch,
+                specs=self._scenario_specs,
+                num_formations=self.config.num_formations,
+            )
+        )
+        self._sample_scenario_chunk = jax.jit(
+            jax.vmap(
+                functools.partial(
+                    sample_scenario_batch,
+                    specs=self._scenario_specs,
+                    num_formations=self.config.num_formations,
+                )
+            )
+        )
+
+    def update_scenario_schedule(self, schedule: Any) -> None:
+        """Swap the training curriculum mid-run (the auto-curriculum
+        seam: ``scenarios.from_falsifiers`` schedules land here).
+
+        The expensive compiled artifact — the train-step / fused-chunk
+        program — is untouched by ANY schedule change: ``ScenarioParams``
+        ride as traced inputs with fixed shapes, so stage tables,
+        severities, and spec magnitudes are pure data (pinned by
+        tests/test_adversary.py with a budget-1 RetraceGuard across the
+        swap). Only the tiny jitted SAMPLER is rebuilt, and only when
+        the spec set changed by VALUE — expect that on every feedback
+        round (a re-fed ``adv:`` spec carries new falsifier magnitudes),
+        a milliseconds-scale host re-jit off the compiled train path;
+        what the stable ``adv:`` names buy is a fixed spec-union SIZE
+        (the sampler's stacked axis and the registry never grow across
+        rounds). The new schedule starts at its own rollout 0; the
+        sampling key stream folds a separate global draw counter that is
+        never reset, so feedback rounds cannot replay early-run draws.
+        Call from the training thread (or between dispatches) — other
+        threads use :meth:`request_scenario_schedule`.
+        """
+        if self._scenario_schedule is None:
+            raise ValueError(
+                "this trainer was built without scenario training — the "
+                "compiled step takes no scenario input, so a schedule "
+                "cannot be installed mid-run (construct the trainer with "
+                "scenarios=['clean'] to reserve the traced seam, then "
+                "update freely)"
+            )
+        from marl_distributedformation_tpu.scenarios import get_scenario
+
+        new_specs = tuple(get_scenario(n) for n in schedule.names)
+        if new_specs != self._scenario_specs:
+            self._scenario_specs = new_specs
+            self._build_scenario_samplers()
+        self._scenario_schedule = schedule
+        self._scenario_rollouts = 0
+        self._resample_scenario_params()
+
+    def request_scenario_schedule(self, schedule: Any) -> None:
+        """Thread-safe curriculum handoff: stash ``schedule`` for the
+        training thread to apply at its next dispatch boundary (the
+        pipeline supervisor's feedback path — it must never mutate
+        sampler state while a dispatch is being prepared). Validates
+        eagerly so the CALLER gets the error, not the training loop."""
+        if self._scenario_schedule is None:
+            raise ValueError(
+                "this trainer was built without scenario training — "
+                "construct it with scenarios=['clean'] to reserve the "
+                "traced scenario seam for curriculum feedback"
+            )
+        from marl_distributedformation_tpu.scenarios import get_scenario
+
+        for name in schedule.names:
+            get_scenario(name)  # unknown names fail in the caller
+        with self._schedule_lock:
+            self._pending_schedule = schedule
+
+    def _apply_pending_schedule(self) -> None:
+        if self._pending_schedule is None:
+            return
+        with self._schedule_lock:
+            pending, self._pending_schedule = self._pending_schedule, None
+        if pending is not None:
+            self.update_scenario_schedule(pending)
+
     def _resample_scenario_params(self) -> None:
         """Redraw the per-formation scenario mix at the schedule's current
         severity (called per dispatch — fresh domain randomization every
@@ -529,7 +618,7 @@ class Trainer:
         schedule = self._scenario_schedule
         self.scenario_severity = schedule.severity_at(self._scenario_rollouts)
         k_sample = jax.random.fold_in(
-            self._scenario_base_key, self._scenario_rollouts
+            self._scenario_base_key, self._scenario_draws
         )
         self.scenario_params = self._sample_scenarios(
             k_sample,
@@ -540,20 +629,28 @@ class Trainer:
     def _next_scenario_chunk(self, k: int):
         """Stacked ``ScenarioParams`` (leading ``(k,)`` axis) for the next
         ``k`` rollouts ``[r0, r0+k)`` — the scan's xs for a fused chunk.
-        Keys fold in each GLOBAL rollout index and severities/probs come
-        off the schedule per iteration, so every scanned iteration trains
-        at exactly the params the host loop would have drawn at its
-        rollout index (bitwise; tests/test_fused_scan.py) and resume
-        re-enters mid-schedule unchanged. One jitted pass, values-only:
-        stage changes and severity ramps never retrace."""
+        Keys fold in each GLOBAL draw index (== the rollout index until a
+        curriculum swap; never reset, so feedback rounds cannot replay
+        early-run draws) and severities/probs come off the schedule per
+        iteration, so every scanned iteration trains at exactly the
+        params the host loop would have drawn at its rollout index
+        (bitwise; tests/test_fused_scan.py) and resume re-enters
+        mid-schedule unchanged. One jitted pass, values-only: stage
+        changes and severity ramps never retrace. The severity row is
+        kept on ``_last_chunk_severities`` so the fused driver logs the
+        EXACT values this chunk trains at (no second schedule read that
+        a concurrent curriculum swap could race)."""
         schedule = self._scenario_schedule
         r0 = self._scenario_rollouts
+        d0 = self._scenario_draws
         keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
-            self._scenario_base_key, jnp.arange(r0, r0 + k)
+            self._scenario_base_key, jnp.arange(d0, d0 + k)
         )
+        severities = schedule.severity_chunk(r0, k)
+        self._last_chunk_severities = severities
         return self._sample_scenario_chunk(
             keys,
-            jnp.asarray(schedule.severity_chunk(r0, k)),
+            jnp.asarray(severities),
             jnp.asarray(schedule.probs_chunk(r0, k)),
         )
 
@@ -569,6 +666,7 @@ class Trainer:
         """Dispatch the jitted program once (``rollouts`` iterations of
         training), under the opt-in runtime guards, and advance the host
         counters. Shared by the host-loop and fused-scan shells."""
+        self._apply_pending_schedule()
         with contextlib.ExitStack() as stack:
             if self.config.guard_transfers and self._dispatches > 0:
                 # Post-warmup only: the compile dispatch legitimately
@@ -602,6 +700,7 @@ class Trainer:
         self._vec_steps_since_save += rollouts * self.ppo.n_steps
         if self._scenario_schedule is not None:
             self._scenario_rollouts += rollouts
+            self._scenario_draws += rollouts
             if not self._fused_chunk and rollouts == 1:
                 # Chunked modes draw their params from
                 # _next_scenario_chunk at dispatch time — resampling the
@@ -731,16 +830,15 @@ class Trainer:
         try:
             while self.num_timesteps < self.total_timesteps:
                 steps_before = self.num_timesteps
-                severities = (
-                    self._scenario_schedule.severity_chunk(
-                        self._scenario_rollouts, k
-                    )
-                    if self._scenario_schedule is not None
-                    else None
-                )
                 tracer.before_dispatch()
                 stacked = self.run_chunk()
                 tracer.after_dispatch(stacked)
+                # The severities this chunk ACTUALLY trained at — stashed
+                # by _next_scenario_chunk inside the dispatch, after any
+                # pending curriculum swap was applied, so a feedback
+                # schedule landing concurrently can never desync the
+                # logged severities from the trained ones.
+                severities = self._last_chunk_severities
                 if pending is not None:
                     last_record = (
                         self._drain_chunk(logger, meter, *pending)
@@ -1047,6 +1145,10 @@ class Trainer:
             self._scenario_rollouts = self.num_timesteps // (
                 self.ppo.n_steps * self.num_envs
             )
+            # The draw counter equals the global rollout index for any
+            # run that has not swapped schedules (mid-run swaps are
+            # live-process state, not checkpointed — docs/adversarial.md).
+            self._scenario_draws = self._scenario_rollouts
             self._resample_scenario_params()
         print(f"[trainer] resumed from {path} at {self.num_timesteps} steps")
 

@@ -56,6 +56,16 @@ PIPELINE_KEYS = (
     "gate_seed",
     "gate_clean_tolerance",
     "gate_rung_tolerance",
+    # adversarial rung + auto-curriculum feedback (docs/adversarial.md)
+    "gate_adversarial",
+    "gate_adversarial_scenarios",
+    "gate_adversarial_min_severity",
+    "gate_adversarial_drop_tolerance",
+    "gate_adversarial_max_severity",
+    "gate_adversarial_grid",
+    "gate_adversarial_generations",
+    "gate_adversarial_formations",
+    "feedback_rollouts",
     # fleet
     "pipeline_replicas",
     "pipeline_buckets",
@@ -96,6 +106,9 @@ def _gate_config(cfg):
     severities = cfg.get("gate_severities") or [0.5, 1.0]
     if not isinstance(severities, list):
         severities = [severities]
+    adv_scenarios = cfg.get("gate_adversarial_scenarios") or []
+    if not isinstance(adv_scenarios, list):
+        adv_scenarios = [adv_scenarios]
     return GateConfig(
         scenarios=tuple(str(s) for s in scenarios),
         severities=tuple(float(s) for s in severities),
@@ -103,6 +116,24 @@ def _gate_config(cfg):
         eval_seed=int(cfg.get("gate_seed", 1234)),
         clean_tolerance=float(cfg.get("gate_clean_tolerance", 0.05)),
         rung_tolerance=float(cfg.get("gate_rung_tolerance", 0.10)),
+        adversarial=bool(cfg.get("gate_adversarial", False)),
+        adversarial_scenarios=tuple(str(s) for s in adv_scenarios),
+        adversarial_min_severity=float(
+            cfg.get("gate_adversarial_min_severity", 0.5)
+        ),
+        adversarial_drop_tolerance=float(
+            cfg.get("gate_adversarial_drop_tolerance", 0.2)
+        ),
+        adversarial_max_severity=float(
+            cfg.get("gate_adversarial_max_severity", 1.5)
+        ),
+        adversarial_grid=int(cfg.get("gate_adversarial_grid", 4)),
+        adversarial_generations=int(
+            cfg.get("gate_adversarial_generations", 3)
+        ),
+        adversarial_formations=int(
+            cfg.get("gate_adversarial_formations", 64)
+        ),
     )
 
 
@@ -148,6 +179,12 @@ def main(argv=None) -> dict:
     from marl_distributedformation_tpu.train import Trainer
 
     env_params = env_params_from_config(cfg)
+    if bool(cfg.get("gate_adversarial", False)) and not cfg.get("scenarios"):
+        # The adversarial rung feeds rejected candidates' falsifiers back
+        # into the trainer's schedule — that needs the traced scenario
+        # seam compiled into the train step. Reserve it with the identity
+        # scenario; the feedback stages replace it live.
+        cfg["scenarios"] = ["clean"]
     trainer = train_entry.build_trainer(cfg)
     if not isinstance(trainer, Trainer):
         raise SystemExit(
@@ -180,6 +217,7 @@ def main(argv=None) -> dict:
         env_params,
         gate_config=_gate_config(cfg),
         poll_interval_s=float(cfg.get("pipeline_poll_s", 0.25)),
+        feedback_rollouts=int(cfg.get("feedback_rollouts", 50)),
     )
     pipeline.attach_trainer(trainer)
 

@@ -209,6 +209,46 @@ def _serving_slo_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _adversarial_problems(rec: dict) -> list[str]:
+    """Structural validation of the adversarial-robustness fields (bench
+    phase 10): whenever a record carries the search throughput, the
+    compile receipt must be budget-1 and the worst-case gap a finite
+    number (NEGATIVE is legitimate — at bench-sized training budgets the
+    curriculum payoff is directional, and an honest record keeps the
+    sign it measured)."""
+    problems = []
+    rate = _present(rec, "adversarial_candidates_per_sec")
+    if rate is not None:
+        try:
+            if not float(rate) > 0.0:
+                problems.append(
+                    f"adversarial_candidates_per_sec={rate!r} (need > 0)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"adversarial_candidates_per_sec is not a number: {rate!r}"
+            )
+        compiles = _present(rec, "adversarial_search_compiles")
+        if compiles != 1:
+            problems.append(
+                f"adversarial_search_compiles={compiles!r} — the "
+                "falsifier search's population program must compile "
+                "exactly once across every generation and checkpoint"
+            )
+    gap = _present(rec, "worst_case_return_gap_pct")
+    if gap is not None:
+        try:
+            if not math.isfinite(float(gap)):
+                problems.append(
+                    f"worst_case_return_gap_pct not finite: {gap!r}"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"worst_case_return_gap_pct is not a number: {gap!r}"
+            )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -224,6 +264,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_pipeline_problems(rec))
     problems.extend(_obs_problems(rec))
     problems.extend(_serving_slo_problems(rec))
+    problems.extend(_adversarial_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(

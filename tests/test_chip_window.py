@@ -257,6 +257,26 @@ def test_check_bench_record_gates():
     assert check(
         {**slo_ok, "serving_slo_max_compiles_per_rung": 2}, [], []
     )
+    # Adversarial-robustness fields (bench phase 10), validated whenever
+    # the search throughput is present: positive rate, budget-1 search
+    # compiles, finite worst-case gap (negative legitimate — bench-sized
+    # training makes the curriculum payoff directional).
+    adv_ok = {
+        **clean,
+        "adversarial_candidates_per_sec": 42.0,
+        "adversarial_search_compiles": 1,
+        "worst_case_return_gap_pct": 5.2,
+    }
+    assert check(adv_ok, [], []) == []
+    assert check({**adv_ok, "worst_case_return_gap_pct": -3.0}, [], []) == []
+    assert check({**adv_ok, "adversarial_candidates_per_sec": 0.0}, [], [])
+    assert check({**adv_ok, "adversarial_search_compiles": 2}, [], [])
+    assert check(
+        {**adv_ok, "worst_case_return_gap_pct": float("nan")}, [], []
+    )
+    assert check(
+        {**adv_ok, "worst_case_return_gap_pct": "better"}, [], []
+    )
     # BENCH_SKIP_* sentinel: "skipped" in a rate field is structurally
     # absent (no SLO validation fires), but --require rejects it with
     # the explicit not-run reason instead of a generic type error.
@@ -264,6 +284,13 @@ def test_check_bench_record_gates():
     assert check(skipped, [], []) == []
     problems = check(skipped, ["serving_req_per_sec_at_p95_slo"], [])
     assert problems and "explicitly skipped" in problems[0]
+    adv_skipped = {
+        **clean,
+        "adversarial_candidates_per_sec": "skipped",
+        "adversarial_search_compiles": "skipped",
+        "worst_case_return_gap_pct": "skipped",
+    }
+    assert check(adv_skipped, [], []) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

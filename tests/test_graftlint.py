@@ -847,6 +847,80 @@ FIXTURES = [
                     jax.device_get(metrics)  # amortized drain: clean
         """,
     ),
+    (
+        # Rule 17: the evolutionary-search foot-gun — a lax loop body
+        # selects candidates through a module-level helper that Python-
+        # branches on a comparison of its (traced) arguments. Rule 2
+        # cannot see it (the helper is not itself a traced scope); the
+        # one-hop follow reports it at the call site.
+        "traced-python-comparison-in-search",
+        """
+        import jax
+        from jax import lax
+
+        def better(best, cand):
+            if cand > best:  # concretizes under the while_loop trace
+                return cand
+            return best
+
+        def search(fitness):
+            def body(state):
+                i, best = state
+                return i + 1, better(best, fitness[i])
+
+            return lax.while_loop(lambda s: s[0] < 8, body, (0, fitness[0]))
+        """,
+        """
+        import jax, jax.numpy as jnp
+        from jax import lax
+
+        def better(best, cand):
+            return jnp.where(cand > best, cand, best)  # stays in-program
+
+        def search(fitness):
+            def body(state):
+                i, best = state
+                return i + 1, better(best, fitness[i])
+
+            return lax.while_loop(lambda s: s[0] < 8, body, (0, fitness[0]))
+        """,
+    ),
+    (
+        # Rule 17, jitted-generation-loop shape: a host `for` loop fused
+        # wholesale into a jitted search calls a threshold helper whose
+        # `while` compares traced arguments.
+        "traced-python-comparison-in-search",
+        """
+        import jax, jax.numpy as jnp
+
+        def clamp(cur, cand, limit):
+            while cand > cur + limit:  # traced comparison, Python loop
+                cand = cand * 0.5
+            return cand
+
+        @jax.jit
+        def evolve(pop, limit):
+            best = pop[0]
+            for _ in range(4):  # generation loop, jitted wholesale
+                best = clamp(best, pop.max(), limit)
+            return best
+        """,
+        """
+        import jax, jax.numpy as jnp
+
+        def clamp(cur, cand, keep_best=True):
+            if keep_best:  # literal-default flag: static, allowed
+                return jnp.maximum(cur, cand)
+            return cand
+
+        @jax.jit
+        def evolve(pop):
+            best = pop[0]
+            for _ in range(4):
+                best = clamp(best, pop.max())
+            return best
+        """,
+    ),
 ]
 
 

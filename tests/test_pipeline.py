@@ -232,27 +232,43 @@ def test_promotion_log_schema(tmp_path):
     assert all(json.loads(ln) for ln in lines)
 
 
-def test_promotion_log_reader_accepts_schema_1_rejects_unknown(tmp_path):
-    """Schema bump 1 -> 2 (trace_id + spans): old logs stay readable —
-    the reader backfills the obs fields as None so schema-2 consumers
-    need no per-line branching — and an UNKNOWN (future) schema fails
-    loudly instead of being silently misread."""
-    assert PROMOTIONS_SCHEMA == 2
+def test_promotion_log_reader_accepts_old_schemas_rejects_unknown(tmp_path):
+    """Schema bumps 1 -> 2 (trace_id + spans) -> 3 (adversarial
+    falsifiers): old logs stay readable — the reader backfills the newer
+    fields as None so schema-3 consumers need no per-line branching —
+    and an UNKNOWN (future) schema fails loudly instead of being
+    silently misread."""
+    assert PROMOTIONS_SCHEMA == 3
     path = tmp_path / "promotions.jsonl"
     with open(path, "w") as f:
         f.write(json.dumps({  # a verbatim PR-7-era line
             "schema": 1, "event": "promoted", "time": 1.0, "step": 10,
             "checkpoint": "rl_model_10_steps.msgpack",
         }) + "\n")
+        f.write(json.dumps({  # a verbatim obs-era (PR 8) line
+            "schema": 2, "event": "promoted", "time": 2.0, "step": 20,
+            "trace_id": "abc123", "spans": {"gate_eval_s": 0.5},
+        }) + "\n")
     PromotionLog(path).append(
-        "promoted", step=20, trace_id="abc123", spans={"gate_eval_s": 0.5}
+        "rejected", step=30, trace_id="def456",
+        falsifiers=[{"scenario": "wind", "severity": 0.4}],
     )
-    old, new = PromotionLog.read(path)
-    assert old["schema"] == 1
-    assert old["trace_id"] is None and old["spans"] is None
-    assert new["schema"] == 2
-    assert new["trace_id"] == "abc123"
-    assert new["spans"] == {"gate_eval_s": 0.5}
+    oldest, obs_era, new = PromotionLog.read(path)
+    assert oldest["schema"] == 1
+    assert oldest["trace_id"] is None and oldest["spans"] is None
+    assert oldest["falsifiers"] is None
+    assert obs_era["schema"] == 2
+    assert obs_era["trace_id"] == "abc123"
+    assert obs_era["spans"] == {"gate_eval_s": 0.5}
+    assert obs_era["falsifiers"] is None
+    assert new["schema"] == 3
+    assert new["trace_id"] == "def456"
+    assert new["falsifiers"] == [{"scenario": "wind", "severity": 0.4}]
+    # A schema-3 line written with the adversarial rung OFF has no
+    # falsifiers key either — the reader backfills None there too, so
+    # consumers never branch per line (or KeyError) on gate config.
+    PromotionLog(path).append("promoted", step=40, trace_id="ghi789")
+    assert PromotionLog.read(path)[-1]["falsifiers"] is None
     with open(path, "a") as f:
         f.write(json.dumps({"schema": 99, "event": "promoted"}) + "\n")
     with pytest.raises(ValueError, match="schema 99"):
