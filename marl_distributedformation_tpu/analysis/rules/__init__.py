@@ -26,6 +26,9 @@ from marl_distributedformation_tpu.analysis.rules.f64_promotion import (
     ImplicitF64Promotion,
 )
 from marl_distributedformation_tpu.analysis.rules.host_sync import HostSyncInJit
+from marl_distributedformation_tpu.analysis.rules.metrics_scope import (
+    MetricsInTracedScope,
+)
 from marl_distributedformation_tpu.analysis.rules.numpy_use import NumpyInJit
 from marl_distributedformation_tpu.analysis.rules.printing import PrintInJit
 from marl_distributedformation_tpu.analysis.rules.prng import PrngKeyReuse
@@ -63,6 +66,7 @@ RULES = (
     SpanInTracedScope(),
     DevicePutInDispatchLoop(),
     TracedComparisonInSearch(),
+    MetricsInTracedScope(),
 )
 
 

@@ -22,8 +22,19 @@ observability.md has the span taxonomy and the propagation diagram):
   exposition (content-negotiated on the fleet's ``GET /v1/metrics``).
 - :class:`~.flightrec.FlightRecorder` — incident-triggered last-N
   snapshots (circuit break, rollback trip, wedged-barrier abort,
-  scheduler worker death) to ``flightrec-*.json``, so postmortems don't
-  depend on having had logging enabled.
+  scheduler worker death, perf-regression trip) to ``flightrec-*.json``,
+  so postmortems don't depend on having had logging enabled.
+- :class:`~.metrics.MetricsRegistry` — the live-metrics plane: process-
+  global counters/gauges/bounded-reservoir histograms recorded from
+  every lane (trainer dispatch loop, pipeline gate, serving fleet) on
+  lock-cheap per-thread shards, exposed as one merged Prometheus
+  namespace via :class:`~.metrics.TelemetryServer` (``GET /metrics``)
+  and the fleet's ``GET /v1/metrics``. graftlint rule 18
+  (``metrics-in-traced-scope``) keeps recording off the compiled path.
+- :class:`~.sentinel.RegressionSentinel` — compares live registry
+  gauges against the newest committed ``BENCH_r*.json`` with a
+  tolerance band and trip hysteresis; sustained degradation dumps a
+  ``flightrec-perf_regression-*.json`` and an audit line.
 
 This package never imports jax — it is pure host-side bookkeeping and
 stays importable from the lint CLI and any frontend process.
@@ -38,6 +49,19 @@ from marl_distributedformation_tpu.obs.export import (  # noqa: F401
 )
 from marl_distributedformation_tpu.obs.flightrec import (  # noqa: F401
     FlightRecorder,
+)
+from marl_distributedformation_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry,
+    TelemetryServer,
+    configure_metrics,
+    get_registry,
+    set_registry,
+)
+from marl_distributedformation_tpu.obs.sentinel import (  # noqa: F401
+    RegressionSentinel,
+    Watch,
+    default_watches,
+    load_bench_record,
 )
 from marl_distributedformation_tpu.obs.tracer import (  # noqa: F401
     TRACE_HEADER,
@@ -54,17 +78,26 @@ from marl_distributedformation_tpu.obs.tracer import (  # noqa: F401
 __all__ = [
     "Event",
     "FlightRecorder",
+    "MetricsRegistry",
     "PROMETHEUS_CONTENT_TYPE",
+    "RegressionSentinel",
     "Span",
     "TRACE_HEADER",
+    "TelemetryServer",
     "Tracer",
+    "Watch",
     "chrome_trace",
     "configure",
+    "configure_metrics",
+    "default_watches",
     "escape_label_value",
+    "get_registry",
     "get_tracer",
+    "load_bench_record",
     "new_trace_id",
     "prometheus_exposition",
     "sanitize_trace_id",
+    "set_registry",
     "set_tracer",
     "wants_prometheus",
 ]

@@ -117,6 +117,13 @@ _RUNG_KIND_KEY = re.compile(
     r"^rung(\d+)_(f32|bf16)_(replicated|sharded)_(.+)$"
 )
 _RUNG_KEY = re.compile(r"^rung(\d+)_(f32|bf16)_(.+)$")
+# Percentile triples — the registry's histogram snapshot keys
+# (``{name}_p50``) and the serving metrics' latency keys
+# (``latency_p50_ms``) — fold into ONE ``summary``-typed family with a
+# ``quantile`` label instead of three ad-hoc gauge names (the same
+# naming discipline the rung gauges got in PR 9).
+_QUANTILE_KEY = re.compile(r"^(.+)_p(50|95|99)(_(?:ms|us|s))?$")
+_QUANTILES = {"50": "0.5", "95": "0.95", "99": "0.99"}
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -154,10 +161,12 @@ def prometheus_exposition(
     a ``replica="i"`` label (per-replica series belong under one metric
     name, not N names); ``rung{B}_{dtype}_{metric}`` keys fold into a
     ``rung_{metric}`` family with ``rung``/``dtype`` labels (the
-    serving ladder's shard/bf16 gauges). ``*_total`` keys are typed
-    ``counter``, the rest ``gauge``. Non-numeric values are skipped — a
-    snapshot is allowed to carry annotations without breaking the
-    scrape."""
+    serving ladder's shard/bf16 gauges); ``{metric}_p50/_p95/_p99``
+    percentile triples (registry histograms, serving latency keys) fold
+    into one ``summary``-typed ``{metric}`` family with ``quantile``
+    labels. ``*_total`` keys are typed ``counter``, the rest ``gauge``.
+    Non-numeric values are skipped — a snapshot is allowed to carry
+    annotations without breaking the scrape."""
     base_labels = [
         (k, str(v)) for k, v in sorted((labels or {}).items())
     ]
@@ -172,6 +181,7 @@ def prometheus_exposition(
         m = _REPLICA_KEY.match(key)
         rung_kind = _RUNG_KIND_KEY.match(key)
         rung = _RUNG_KEY.match(key)
+        quantile = _QUANTILE_KEY.match(key)
         if m:
             metric, extra = m.group(2), [("replica", m.group(1))]
         elif rung_kind:
@@ -184,10 +194,18 @@ def prometheus_exposition(
         elif rung:
             metric = f"rung_{rung.group(3)}"
             extra = [("dtype", rung.group(2)), ("rung", rung.group(1))]
+        elif quantile:
+            metric = quantile.group(1) + (quantile.group(3) or "")
+            extra = [("quantile", _QUANTILES[quantile.group(2)])]
         else:
             metric, extra = key, []
         name = _metric_name(metric, namespace)
-        kind = "counter" if metric.endswith("_total") else "gauge"
+        if quantile and not (m or rung_kind or rung):
+            kind = "summary"
+        elif metric.endswith("_total"):
+            kind = "counter"
+        else:
+            kind = "gauge"
         fam = families.setdefault(name, (kind, []))
         fam[1].append((base_labels + extra, v))
     lines: List[str] = []

@@ -116,13 +116,21 @@ class FlightRecorder:
             stale.unlink(missing_ok=True)
 
 
-def _jsonable(context: Dict[str, Any]) -> Dict[str, Any]:
-    """Best-effort JSON-safe copy of incident context (reprs for
-    anything exotic — the dump must always serialize)."""
+def _jsonable(context: Dict[str, Any], depth: int = 2) -> Any:
+    """Best-effort JSON-safe copy of incident context (one level of
+    dict/list nesting preserved — the sentinel attaches a whole metrics
+    snapshot — reprs for anything exotic; the dump must always
+    serialize)."""
     out: Dict[str, Any] = {}
     for k, v in context.items():
         if isinstance(v, (str, int, float, bool)) or v is None:
             out[str(k)] = v
+        elif isinstance(v, dict) and depth > 0:
+            out[str(k)] = _jsonable(v, depth - 1)
+        elif isinstance(v, (list, tuple)) and depth > 0 and all(
+            isinstance(e, (str, int, float, bool)) or e is None for e in v
+        ):
+            out[str(k)] = list(v)
         else:
             out[str(k)] = repr(v)
     return out

@@ -37,7 +37,11 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from marl_distributedformation_tpu.env import EnvParams
-from marl_distributedformation_tpu.obs import get_tracer, new_trace_id
+from marl_distributedformation_tpu.obs import (
+    get_registry,
+    get_tracer,
+    new_trace_id,
+)
 from marl_distributedformation_tpu.pipeline.gate import (
     GateConfig,
     GateVerdict,
@@ -195,6 +199,7 @@ class AlwaysLearningPipeline:
         and the ``promotions.jsonl`` line — one trace reconstructs the
         whole promotion."""
         tracer = get_tracer()
+        registry = get_registry()
         tr = _PromotionTrace(path)
         t_gate_start = time.time()
         if tr.t_write is not None:
@@ -208,12 +213,23 @@ class AlwaysLearningPipeline:
                 trace_id=tr.trace_id,
                 path=str(path),
             )
+            # Live lag gauge: how far behind the trainer's durable
+            # writes the gate is running right now.
+            registry.gauge("pipeline_stream_poll_lag_seconds").set(
+                t_gate_start - tr.t_write
+            )
         t0 = time.perf_counter()
         with tracer.span("promotion.gate_eval", trace_id=tr.trace_id):
             verdict = self.gate.evaluate(path, trace_id=tr.trace_id)
-        tr.add("gate_eval_s", time.perf_counter() - t0)
+        gate_eval_s = time.perf_counter() - t0
+        tr.add("gate_eval_s", gate_eval_s)
+        registry.histogram("pipeline_gate_eval_seconds").observe(gate_eval_s)
+        registry.gauge("gate_eval_steps_per_sec").set(
+            self.gate.eval_steps_per_sec()
+        )
         if not verdict.passed:
             self.rejections.append(verdict)
+            registry.counter("pipeline_rejections_total").inc()
             self.log.append(
                 "rejected", **verdict.record(), trace_id=tr.trace_id
             )
@@ -239,6 +255,7 @@ class AlwaysLearningPipeline:
             if self.coordinator.fleet_step < verdict.step:
                 tr.deferred_at = time.time()
                 self._deferred.append((verdict, str(promoted), path, tr))
+                get_registry().counter("pipeline_deferred_total").inc()
                 self.log.append(
                     "promotion_deferred",
                     **verdict.record(),
@@ -355,6 +372,11 @@ class AlwaysLearningPipeline:
         )
         self.promotions.append(record)
         self._good.append(record)
+        registry = get_registry()
+        registry.counter("pipeline_promotions_total").inc()
+        registry.gauge("pipeline_served_step").set(verdict.step)
+        if latency is not None:
+            registry.histogram("promotion_latency_seconds").observe(latency)
         if self.monitor is not None:
             self.monitor.reset()
         self.log.append(
@@ -494,6 +516,9 @@ class AlwaysLearningPipeline:
         self.gate.rebase(last_good.step)
         self.monitor.reset()
         self.rollbacks.append(entry)
+        registry = get_registry()
+        registry.counter("pipeline_rollbacks_total").inc()
+        registry.gauge("pipeline_served_step").set(last_good.step)
         self.log.append("rolled_back", **entry, trace_id=rollback_trace)
         return True
 

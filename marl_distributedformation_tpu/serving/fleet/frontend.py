@@ -145,9 +145,21 @@ def _make_handler(router: FleetRouter):
             elif self.path == "/v1/metrics":
                 snap = router.snapshot()
                 if wants_prometheus(self.headers.get("Accept")):
+                    # The Prometheus view is the MERGED namespace: the
+                    # process registry (trainer/pipeline/checkpoint
+                    # gauges, when co-resident) plus this fleet's
+                    # families; the fleet's own keys win on overlap.
+                    # The JSON default stays byte-identical to the
+                    # router snapshot.
+                    from marl_distributedformation_tpu.obs.metrics import (
+                        get_registry,
+                    )
+
+                    merged = get_registry().snapshot()
+                    merged.update(snap)
                     self._reply_text(
                         200,
-                        prometheus_exposition(snap),
+                        prometheus_exposition(merged),
                         PROMETHEUS_CONTENT_TYPE,
                     )
                 else:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Sequence
 
+from marl_distributedformation_tpu.obs.metrics import get_registry
 from marl_distributedformation_tpu.serving.metrics import ServingMetrics
 
 
@@ -138,4 +139,11 @@ class FleetMetrics:
         out["latency_p50_ms"] = 1e3 * pct(ordered, 0.50)
         out["latency_p95_ms"] = 1e3 * pct(ordered, 0.95)
         out["latency_p99_ms"] = 1e3 * pct(ordered, 0.99)
+        # Registry-backed emission (obs/metrics.py): every snapshot also
+        # lands in the process-global registry, so the serving families
+        # and the trainer/pipeline gauges render as ONE merged Prometheus
+        # namespace (fleet ``GET /v1/metrics`` text view, the
+        # TelemetryServer's ``GET /metrics``, and the RollbackMonitor's
+        # sampling path all read the same numbers).
+        get_registry().record_gauges(out)
         return out

@@ -17,6 +17,7 @@ import contextlib
 import dataclasses
 import functools
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -33,6 +34,7 @@ from marl_distributedformation_tpu.algo import (
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.env.formation import compute_obs, reset_batch
 from marl_distributedformation_tpu.models import MLPActorCritic
+from marl_distributedformation_tpu.obs.metrics import get_registry
 from marl_distributedformation_tpu.utils import profiling
 from marl_distributedformation_tpu.utils import (
     AsyncCheckpointWriter,
@@ -696,6 +698,10 @@ class Trainer:
                 self.train_state, self.env_state, self.obs, self.key, *extra
             )
         self._dispatches += 1
+        # Live-metrics plane (obs/metrics.py, docs/observability.md):
+        # recorded at the dispatch seam, never under trace (graftlint
+        # rule 18). Two dict ops per dispatch — noise next to a rollout.
+        get_registry().counter("train_iterations_total").inc(rollouts)
         self.num_timesteps += rollouts * self.ppo.n_steps * self.num_envs
         self._vec_steps_since_save += rollouts * self.ppo.n_steps
         if self._scenario_schedule is not None:
@@ -760,6 +766,10 @@ class Trainer:
                     * self.ppo.n_steps
                     * self.config.num_formations
                 )
+                # Live gauges every dispatch (three dict writes), not
+                # just at log cadence — GET /metrics must answer "how
+                # fast right now" even when log_interval is long.
+                self._record_lane_metrics(meter.rate())
                 if iteration % self.config.log_interval == 0:
                     # One host sync per log interval, after dispatch — a
                     # single batched device_get, NOT per-metric float():
@@ -868,6 +878,20 @@ class Trainer:
             logger.close()
         return last_record
 
+    def _record_lane_metrics(self, env_steps_rate: float) -> None:
+        """Publish this lane's throughput gauges into the process
+        registry (the ``GET /metrics`` namespace): env-steps/s,
+        train-steps/s, and the live RetraceGuard compile counter —
+        what ROADMAP item 3's autoscaler and the RegressionSentinel
+        watch. Host-seam only (the drain, after device_get)."""
+        registry = get_registry()
+        registry.gauge("train_env_steps_per_sec").set(env_steps_rate)
+        per_iter = self.ppo.n_steps * self.config.num_formations
+        registry.gauge("train_steps_per_sec").set(
+            env_steps_rate / per_iter if per_iter else 0.0
+        )
+        registry.gauge("train_compiles").set(self.retrace_guard.count)
+
     def _drain_chunk(
         self, logger, meter, stacked, first_iteration, steps_before,
         severities,
@@ -876,10 +900,17 @@ class Trainer:
         emit per-iteration records exactly like the host loop would.
         Called after the NEXT chunk has been dispatched, so this blocks on
         the finished chunk while the device already runs the new one."""
+        t_drain = time.perf_counter()
         host = jax.device_get(stacked)
         meter.tick(
             self._fused_chunk * self.ppo.n_steps * self.config.num_formations
         )
+        registry = get_registry()
+        registry.histogram("train_chunk_drain_seconds").observe(
+            time.perf_counter() - t_drain
+        )
+        registry.counter("train_chunks_total").inc()
+        self._record_lane_metrics(meter.rate())
         per_iter = self.ppo.n_steps * self.num_envs
         last_record: Dict[str, float] = {}
         for i in range(self._fused_chunk):

@@ -188,7 +188,12 @@ class AsyncCheckpointWriter:
         and must keep :func:`_write_atomic`'s torn-write invariant for
         every file it produces. Same pipeline contract as :meth:`submit`:
         one write in flight, errors surface on the next submit/close."""
+        from marl_distributedformation_tpu.obs.metrics import get_registry
+
         self.wait()
+        # Live-metrics plane: single-flight writer, so depth is 0 or 1 —
+        # a depth stuck at 1 means training outruns checkpoint IO.
+        get_registry().gauge("checkpoint_queue_depth").set(1.0)
         thread = threading.Thread(
             target=self._run, args=(write_fn,),
             daemon=True, name="ckpt-writer",
@@ -197,10 +202,20 @@ class AsyncCheckpointWriter:
         thread.start()
 
     def _run(self, write_fn: Any) -> None:
+        from marl_distributedformation_tpu.obs.metrics import get_registry
+
+        t0 = time.perf_counter()
         try:
             write_fn()
+            registry = get_registry()
+            registry.histogram("checkpoint_write_seconds").observe(
+                time.perf_counter() - t0
+            )
+            registry.counter("checkpoint_writes_total").inc()
         except BaseException as e:  # noqa: BLE001 — surfaced on wait()
             self._error = e
+        finally:
+            get_registry().gauge("checkpoint_queue_depth").set(0.0)
 
     def wait(self) -> None:
         """Join the in-flight write (if any); re-raise its failure."""

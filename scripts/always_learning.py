@@ -84,6 +84,15 @@ PIPELINE_KEYS = (
     "obs_trace",
     "obs_ring_size",
     "obs_flightrec",
+    # live-metrics plane (obs/metrics.py, docs/observability.md)
+    "telemetry",
+    "telemetry_port",
+    "telemetry_reservoir",
+    # perf-regression sentinel (obs/sentinel.py)
+    "sentinel",
+    "sentinel_tolerance",
+    "sentinel_trip_after",
+    "sentinel_bench",
     "out",
 )
 # Trainer knobs are the normal YAML config surface (train.py is
@@ -141,10 +150,28 @@ def _monitor(cfg, router):
     metric = cfg.get("rollback_metric")
     if not metric:
         return None
+    from marl_distributedformation_tpu.obs import get_registry
     from marl_distributedformation_tpu.pipeline import RollbackMonitor
 
+    def sample():
+        # One sampling code path fleet-wide (obs/metrics.py): the
+        # router snapshot refreshes the fleet gauges in the process
+        # registry (FleetMetrics.snapshot publishes as a side effect),
+        # then the monitor reads the MERGED registry namespace — the
+        # same numbers GET /metrics serves, and any trainer/pipeline
+        # gauge is now watchable too, not just fleet keys. The fresh
+        # fleet snapshot overlays the registry copy so the monitored
+        # metric can never be a stale gauge; with telemetry disabled
+        # the registry is empty and the monitor falls back to exactly
+        # the fleet snapshot — the telemetry off-switch must never
+        # blind the rollback tripwire.
+        snap = router.snapshot()
+        merged = get_registry().snapshot()
+        merged.update(snap)
+        return merged
+
     return RollbackMonitor(
-        router.snapshot,
+        sample,
         metric=str(metric),
         threshold=cfg.get("rollback_threshold"),
         ratio=cfg.get("rollback_ratio"),
@@ -209,6 +236,50 @@ def main(argv=None) -> dict:
             else ""
         ),
     )
+    # Live-metrics plane (obs/metrics.py): the trainer's dispatch loop,
+    # the gate, and the fleet all record into the process registry;
+    # telemetry_port serves the merged namespace as Prometheus text
+    # (GET /metrics) so a pipeline run exports everything ROADMAP item
+    # 3's autoscaler needs without a fleet frontend.
+    obs_spine.configure_metrics(
+        enabled=bool(cfg.get("telemetry", True)),
+        reservoir=int(cfg.get("telemetry_reservoir", 512)),
+    )
+    telemetry = None
+    telemetry_port = cfg.get("telemetry_port")
+    if telemetry_port is not None:
+        telemetry = obs_spine.TelemetryServer(
+            port=int(telemetry_port)
+        ).start()
+        report_telemetry_url = telemetry.url
+        print(f"[always] telemetry: {telemetry.url}", file=sys.stderr)
+    else:
+        report_telemetry_url = None
+
+    # Perf-regression sentinel (obs/sentinel.py): live gauges vs the
+    # newest committed BENCH record; a sustained regression dumps a
+    # flightrec-perf_regression-*.json and an audit line beside the
+    # checkpoints.
+    sentinel = None
+    if bool(cfg.get("sentinel", False)):
+        if not bool(cfg.get("telemetry", True)):
+            # The sentinel compares LIVE registry gauges; with the
+            # registry disabled every snapshot is empty and the
+            # tripwire is silently blind — refuse loudly instead.
+            raise SystemExit(
+                "sentinel=true needs telemetry=true (the sentinel "
+                "watches the live MetricsRegistry gauges; a disabled "
+                "registry records nothing, so no regression could "
+                "ever trip)"
+            )
+        sentinel = obs_spine.RegressionSentinel(
+            obs_spine.default_watches(
+                tolerance=float(cfg.get("sentinel_tolerance", 0.5))
+            ),
+            record_path=cfg.get("sentinel_bench"),
+            trip_after=int(cfg.get("sentinel_trip_after", 3)),
+            audit_dir=trainer.log_dir,
+        )
 
     budget_s = float(cfg.get("pipeline_budget_s", 600.0))
     deadline = time.time() + budget_s
@@ -286,6 +357,13 @@ def main(argv=None) -> dict:
         # then drain the tail after it finishes.
         while time.time() < deadline:
             processed = pipeline.poll_once()
+            if sentinel is not None:
+                # Refresh the fleet families first (FleetMetrics
+                # publishes on every snapshot read) so the latency
+                # watch sees live numbers even when no monitor or
+                # external scraper is driving reads.
+                router.snapshot()
+                sentinel.check()
             if not train_thread.is_alive() and processed == 0:
                 # The trainer may have written its final checkpoint
                 # between our poll and the liveness check (train()
@@ -312,6 +390,10 @@ def main(argv=None) -> dict:
             served_steps.append(int(res.model_step))
 
         report.update(pipeline.summary())
+        if sentinel is not None:
+            report.update(sentinel.summary())
+        if report_telemetry_url is not None:
+            report["telemetry_url"] = report_telemetry_url
         report["pipeline_replicas"] = replicas
         report["fleet_swap_count"] = coordinator.swap_count
         report["verified_served_steps"] = served_steps
@@ -328,6 +410,8 @@ def main(argv=None) -> dict:
             default=0,
         )
     finally:
+        if telemetry is not None:
+            telemetry.stop()
         if frontend is not None:
             frontend.stop()
         if router is not None:

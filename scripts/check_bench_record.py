@@ -158,6 +158,37 @@ def _obs_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _telemetry_problems(rec: dict) -> list[str]:
+    """Structural validation of the live-metrics-plane fields (bench
+    phase 11): a telemetry overhead that is not a finite number, or a
+    sentinel poll rate that is zero/negative, is a malformed record
+    whenever present."""
+    problems = []
+    pct = _present(rec, "telemetry_overhead_pct")
+    if pct is not None:
+        try:
+            if not math.isfinite(float(pct)):
+                problems.append(
+                    f"telemetry_overhead_pct not finite: {pct!r}"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"telemetry_overhead_pct is not a number: {pct!r}"
+            )
+    rate = _present(rec, "sentinel_checks_per_sec")
+    if rate is not None:
+        try:
+            if not float(rate) > 0.0:
+                problems.append(
+                    f"sentinel_checks_per_sec={rate!r} (need > 0)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"sentinel_checks_per_sec is not a number: {rate!r}"
+            )
+    return problems
+
+
 def _serving_slo_problems(rec: dict) -> list[str]:
     """Structural validation of the SLO serving fields (bench phase 9):
     whenever a record carries the req/s-at-SLO headline, the load-gen
@@ -263,6 +294,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
         problems.append(f"degraded phases in notes: {notes!r}")
     problems.extend(_pipeline_problems(rec))
     problems.extend(_obs_problems(rec))
+    problems.extend(_telemetry_problems(rec))
     problems.extend(_serving_slo_problems(rec))
     problems.extend(_adversarial_problems(rec))
     for field in require:

@@ -291,6 +291,28 @@ def test_check_bench_record_gates():
         "worst_case_return_gap_pct": "skipped",
     }
     assert check(adv_skipped, [], []) == []
+    # Live-metrics-plane fields (bench phase 11), validated whenever
+    # present: finite telemetry overhead (negative legitimate — noise
+    # around zero is the expected result), positive sentinel poll rate,
+    # "skipped" sentinels structurally absent.
+    tel_ok = {
+        **clean,
+        "telemetry_overhead_pct": -0.1,
+        "sentinel_checks_per_sec": 87488.7,
+    }
+    assert check(tel_ok, [], []) == []
+    assert check({**tel_ok, "telemetry_overhead_pct": float("nan")}, [], [])
+    assert check({**tel_ok, "telemetry_overhead_pct": "cheap"}, [], [])
+    assert check({**tel_ok, "sentinel_checks_per_sec": 0.0}, [], [])
+    assert check({**tel_ok, "sentinel_checks_per_sec": "many"}, [], [])
+    assert check(
+        {
+            **clean,
+            "telemetry_overhead_pct": "skipped",
+            "sentinel_checks_per_sec": "skipped",
+        },
+        [], [],
+    ) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

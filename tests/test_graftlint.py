@@ -921,6 +921,68 @@ FIXTURES = [
             return best
         """,
     ),
+    (
+        # Rule 18: MetricsRegistry recording under trace — the counter
+        # bumps once at COMPILE time, then never again, while the code
+        # looks instrumented. The good twin records at the dispatch
+        # seam around the jitted call.
+        "metrics-in-traced-scope",
+        """
+        import jax
+        from marl_distributedformation_tpu.obs.metrics import get_registry
+
+        @jax.jit
+        def step(x):
+            get_registry().counter("steps_total").inc()
+            return x * 2
+        """,
+        """
+        import jax
+        from marl_distributedformation_tpu.obs.metrics import get_registry
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def dispatch(x):
+            out = step(x)
+            get_registry().counter("steps_total").inc()
+            return out
+        """,
+    ),
+    (
+        # Same hazard one hop away inside a scan body, through a
+        # registry-receiver chain: the helper's observe() would record
+        # per trace, not per iteration. The good twin's helper is only
+        # called from the host-side drain.
+        "metrics-in-traced-scope",
+        """
+        from jax import lax
+
+        def note(registry, dt):
+            registry.histogram("iter_seconds").observe(dt)
+
+        def train(registry, xs):
+            def body(carry, x):
+                note(registry, x)
+                return carry + x, x
+            return lax.scan(body, 0.0, xs)
+        """,
+        """
+        from jax import lax
+
+        def note(registry, dt):
+            registry.histogram("chunk_seconds").observe(dt)
+
+        def train(registry, xs):
+            def body(carry, x):
+                return carry + x, x
+            carry, stacked = lax.scan(body, 0.0, xs)
+            note(registry, 0.1)  # the drain seam: host-side
+            registry.gauge("steps_per_sec").set(1.0)
+            return carry, stacked
+        """,
+    ),
 ]
 
 

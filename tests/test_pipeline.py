@@ -75,6 +75,21 @@ def private_tracer(tmp_path):
     set_tracer(previous)
 
 
+@pytest.fixture
+def private_registry():
+    """A test-private MetricsRegistry installed as the process-global
+    one (the pipeline's seams resolve get_registry() at call time)."""
+    from marl_distributedformation_tpu.obs import (
+        MetricsRegistry,
+        set_registry,
+    )
+
+    registry = MetricsRegistry()
+    previous = set_registry(registry)
+    yield registry
+    set_registry(previous)
+
+
 # ---------------------------------------------------------------------------
 # Incremental discovery (utils.checkpoint.CheckpointDiscovery)
 # ---------------------------------------------------------------------------
@@ -590,7 +605,7 @@ def test_gate_rebase_survives_evicted_history():
 # ---------------------------------------------------------------------------
 
 
-def test_pipeline_end_to_end(tmp_path, private_tracer):
+def test_pipeline_end_to_end(tmp_path, private_tracer, private_registry):
     assert len(jax.local_devices()) >= 2  # the conftest mesh
 
     log_dir = tmp_path / "run"
@@ -710,6 +725,32 @@ def test_pipeline_end_to_end(tmp_path, private_tracer):
     assert summary["promotions"] == len(pipeline.promotions)
     assert summary["rollbacks"] == 1
     assert summary["gate_eval_steps_per_sec"] > 0
+
+    # --- The live-metrics plane (ISSUE 11): the pipeline lane recorded
+    # its counters/gauges/histograms into the process registry, merged
+    # with the fleet families (any FleetMetrics.snapshot reader — the
+    # emit pacer, /v1/metrics, the rollback sampler — publishes them
+    # there), so ONE Prometheus namespace carries the whole loop. ---
+    router.snapshot()  # the sampling path: one read refreshes the gauges
+    live = private_registry.snapshot()
+    assert live["pipeline_promotions_total"] == float(summary["promotions"])
+    assert live["pipeline_rejections_total"] == float(summary["rejections"])
+    assert live["pipeline_rollbacks_total"] == 1.0
+    assert live["pipeline_served_step"] == float(s1)  # post-rollback
+    assert live["gate_eval_steps_per_sec"] > 0.0
+    assert live["pipeline_gate_eval_seconds_count"] >= 3.0
+    assert live["pipeline_gate_eval_seconds_p50"] > 0.0
+    assert live["pipeline_stream_poll_lag_seconds"] >= 0.0
+    assert live["promotion_latency_seconds_count"] >= 1.0
+    # Fleet families folded into the same namespace by snapshot().
+    assert live["fleet_routed_total"] >= 1.0
+    assert "latency_p95_ms" in live
+    # And the merged dict renders as parseable Prometheus text.
+    from marl_distributedformation_tpu.obs import prometheus_exposition
+
+    text = prometheus_exposition(live)
+    assert "# TYPE marl_pipeline_promotions_total counter" in text
+    assert "# TYPE marl_pipeline_gate_eval_seconds summary" in text
 
     # --- The obs spine (ISSUE 8 acceptance): ONE trace reconstructs a
     # promotion end to end, and its span decomposition sums to the

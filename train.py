@@ -315,13 +315,35 @@ def main(argv=None) -> None:
         )
     trainer = build_trainer(cfg)
     _snapshot_config(cfg, trainer.log_dir)
+    # Live-metrics plane (obs/metrics.py, docs/observability.md): the
+    # trainer records env-steps/s, chunk drain latency, checkpoint-writer
+    # health, and compile counters into the process registry;
+    # telemetry_port serves them as Prometheus text on GET /metrics so a
+    # bare training run is scrapeable without a serving fleet.
+    from marl_distributedformation_tpu.obs import (
+        TelemetryServer,
+        configure_metrics,
+    )
+
+    configure_metrics(
+        enabled=bool(cfg.get("telemetry", True)),
+        reservoir=int(cfg.get("telemetry_reservoir", 512)),
+    )
+    telemetry = None
+    if cfg.get("telemetry_port") is not None:
+        telemetry = TelemetryServer(port=int(cfg.telemetry_port)).start()
+        print(f"[train] telemetry: {telemetry.url}")
     print(
         f"[train] {cfg.name}: M={cfg.num_formation} formations x "
         f"N={cfg.num_agents_per_formation} agents, "
         f"{trainer.total_timesteps} agent-transitions, "
         f"logs -> {trainer.log_dir}"
     )
-    final = trainer.train()
+    try:
+        final = trainer.train()
+    finally:
+        if telemetry is not None:
+            telemetry.stop()
     print(f"[train] done at {trainer.num_timesteps} steps: {final}")
 
 
