@@ -60,6 +60,12 @@ Measures, inside one process and one JSON line:
   trained policies) and ``worst_case_return_gap_pct``: the
   auto-curriculum payoff — curriculum-trained vs clean-trained return
   at the discovered worst cases, equal training steps.
+- ``chaos_mttr_s`` / ``chaos_invariant_violations`` /
+  ``fault_plane_overhead_pct``: the chaos plane (chaos/,
+  scripts/chaos_storm.py) — one seeded fault campaign through the
+  whole trainer -> gate -> fleet loop; MTTR is worst kill -> first
+  served recovery, violations MUST be 0, and the disabled plane's
+  per-request cost is ~0 (one attribute read per injection point).
 
 Phases skipped via
   ``BENCH_SKIP_*`` env vars record the explicit ``"skipped"`` sentinel
@@ -90,7 +96,8 @@ BENCH_SERVING_DURATION_S, BENCH_SKIP_PIPELINE=1, BENCH_PIPELINE_M,
 BENCH_PIPELINE_GATE_M, BENCH_PIPELINE_BUDGET_S, BENCH_SLO_DURATION_S,
 BENCH_SLO_P95_MS, BENCH_SKIP_ADVERSARIAL=1, BENCH_ADV_M,
 BENCH_ADV_ITERS, BENCH_ADV_EVAL_M, BENCH_TELEMETRY_CHUNK,
-BENCH_TELEMETRY_PASSES, BENCH_SENTINEL_CHECKS.
+BENCH_TELEMETRY_PASSES, BENCH_SENTINEL_CHECKS, BENCH_SKIP_CHAOS=1,
+BENCH_CHAOS_SEED, BENCH_CHAOS_FAULTS.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -1767,6 +1774,73 @@ def main() -> None:
                 notes.append(f"telemetry phase failed: {e!r}"[:200])
         else:
             notes.append("telemetry phase skipped: deadline")
+
+        # --- Phase 12: chaos plane (chaos/, scripts/chaos_storm.py,
+        # docs/chaos.md): one seeded fault campaign through trainer ->
+        # gate -> fleet. Three headline fields: chaos_mttr_s (worst
+        # kill -> first-served-recovery over the campaign's disruptive
+        # faults), chaos_invariant_violations (step monotonicity,
+        # no-request-lost, budget-1 receipts, audit-log + checkpoint-dir
+        # consistency — MUST be 0), and fault_plane_overhead_pct (the
+        # disabled plane's per-request cost, ~0: one attribute read per
+        # injection point). The campaign replays bit-identically from
+        # chaos_seed (scripts/chaos_storm.py --print-schedule).
+        chaos_fields = (
+            "chaos_mttr_s",
+            "chaos_invariant_violations",
+            "fault_plane_overhead_pct",
+        )
+        if os.environ.get("BENCH_SKIP_CHAOS") == "1":
+            _mark_skipped(result, "chaos", chaos_fields)
+        elif time.time() < deadline - 60:
+            try:
+                import tempfile
+
+                sys.path.insert(
+                    0,
+                    os.path.join(os.path.dirname(__file__), "scripts"),
+                )
+                try:
+                    from chaos_storm import run_campaign
+                finally:
+                    sys.path.pop(0)
+
+                chaos_seed = _env_int("BENCH_CHAOS_SEED", 0)
+                chaos_report = run_campaign(
+                    seed=chaos_seed,
+                    faults=_env_int("BENCH_CHAOS_FAULTS", 25),
+                    workdir=tempfile.mkdtemp(prefix="bench_chaos_"),
+                    budget_s=max(30.0, deadline - time.time() - 15.0),
+                )
+                result["chaos_seed"] = chaos_seed
+                result["chaos_invariant_violations"] = chaos_report[
+                    "chaos_invariant_violations"
+                ]
+                result["chaos_faults_fired"] = chaos_report[
+                    "chaos_faults_fired"
+                ]
+                if "chaos_mttr_s" in chaos_report:
+                    result["chaos_mttr_s"] = chaos_report["chaos_mttr_s"]
+                result["fault_plane_overhead_pct"] = chaos_report[
+                    "fault_plane_overhead_pct"
+                ]
+                result["chaos_pipeline_restarts"] = chaos_report[
+                    "pipeline_restarts"
+                ]
+                print(
+                    "[bench] chaos: "
+                    f"{chaos_report['chaos_faults_fired']} faults fired, "
+                    f"{chaos_report['chaos_invariant_violations']} "
+                    "invariant violations, MTTR "
+                    f"{chaos_report.get('chaos_mttr_s', 'n/a')}s, "
+                    "disabled-plane overhead "
+                    f"{chaos_report['fault_plane_overhead_pct']}%",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                notes.append(f"chaos phase failed: {e!r}"[:200])
+        else:
+            notes.append("chaos phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

@@ -25,6 +25,9 @@ from marl_distributedformation_tpu.analysis.rules.donation import MissingDonate
 from marl_distributedformation_tpu.analysis.rules.f64_promotion import (
     ImplicitF64Promotion,
 )
+from marl_distributedformation_tpu.analysis.rules.fault_scope import (
+    FaultPointInTracedScope,
+)
 from marl_distributedformation_tpu.analysis.rules.host_sync import HostSyncInJit
 from marl_distributedformation_tpu.analysis.rules.metrics_scope import (
     MetricsInTracedScope,
@@ -67,6 +70,7 @@ RULES = (
     DevicePutInDispatchLoop(),
     TracedComparisonInSearch(),
     MetricsInTracedScope(),
+    FaultPointInTracedScope(),
 )
 
 

@@ -1,0 +1,71 @@
+"""Chaos plane: deterministic fault injection, invariant checking, and
+self-healing supervision (docs/chaos.md).
+
+- :mod:`.plane` — the :class:`FaultPlane` and its seeded
+  :class:`FaultSchedule`; production seams call :func:`fault_point`
+  (one attribute read when disabled; graftlint rule 19 keeps it out of
+  traced scopes).
+- :mod:`.invariants` — pure checkers over campaign artifacts (step
+  monotonicity, no-request-lost, budget-1 receipts, audit-log and
+  checkpoint-dir consistency) plus the ``chaos_violation`` flight-
+  recorder alarm.
+- :mod:`.watchdog` — heartbeat-driven lane supervision with capped-
+  backoff restarts.
+
+``scripts/chaos_storm.py`` runs trainer -> gate -> fleet under a seeded
+campaign and reports MTTR + violations as one JSON line.
+"""
+
+from marl_distributedformation_tpu.chaos.invariants import (
+    Violation,
+    check_audit_log,
+    check_budget_one,
+    check_checkpoint_dir,
+    check_no_request_lost,
+    check_step_monotonic,
+    report_violations,
+)
+from marl_distributedformation_tpu.chaos.plane import (
+    DISRUPTIVE_KINDS,
+    FAULT_KINDS,
+    INJECTION_POINTS,
+    FaultPlane,
+    FaultSchedule,
+    FaultSpec,
+    InjectedFault,
+    SimulatedCrash,
+    configure_chaos,
+    fault_point,
+    get_fault_plane,
+    set_fault_plane,
+)
+from marl_distributedformation_tpu.chaos.watchdog import (
+    Heartbeat,
+    Lane,
+    LaneWatchdog,
+)
+
+__all__ = [
+    "DISRUPTIVE_KINDS",
+    "FAULT_KINDS",
+    "INJECTION_POINTS",
+    "FaultPlane",
+    "FaultSchedule",
+    "FaultSpec",
+    "Heartbeat",
+    "InjectedFault",
+    "Lane",
+    "LaneWatchdog",
+    "SimulatedCrash",
+    "Violation",
+    "check_audit_log",
+    "check_budget_one",
+    "check_checkpoint_dir",
+    "check_no_request_lost",
+    "check_step_monotonic",
+    "configure_chaos",
+    "fault_point",
+    "get_fault_plane",
+    "report_violations",
+    "set_fault_plane",
+]

@@ -1,0 +1,269 @@
+"""Invariant checkers: what must still be true after a chaos campaign.
+
+Each checker is a pure function over campaign artifacts (served-step
+samples, probe outcomes, compile receipts, ``promotions.jsonl``, a
+checkpoint directory) returning a list of :class:`Violation` — empty
+means the invariant held through whatever the fault schedule did.
+:func:`report_violations` is the alarm half: every tripped checker
+becomes a ``chaos_violation`` flight-recorder incident carrying the
+recent span history plus the armed/fired fault schedule as structured
+context, so a failing campaign is diagnosable from its artifacts alone
+(no re-run, no debugger).
+
+The invariants are the ones PRs 4-11 individually earned, restated so
+one campaign exercises them all (ROADMAP item 1 wants exactly this
+restating before the fleet crosses the host boundary):
+
+- **step monotonicity** — ``model_step`` never goes backward in
+  response order, except across an audited rollback;
+- **no accepted request lost** — every admitted request resolves
+  (result or typed error), none wedge forever;
+- **budget-1 compile receipts** — the gate's matrix program and every
+  serving rung compile at most once, faults or no faults;
+- **audit-log consistency** — ``promotions.jsonl`` parses, promoted
+  steps ascend, rollbacks demote to previously-promoted steps,
+  superseded candidates never serve;
+- **checkpoint-dir crash consistency** — every discoverable checkpoint
+  is checksum-valid; torn writes are invisible (``.tmp``), corrupt
+  files are quarantined aside, never served.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from marl_distributedformation_tpu.chaos.plane import (
+    FaultPlane,
+    get_fault_plane,
+)
+
+
+@dataclasses.dataclass
+class Violation:
+    """One tripped invariant."""
+
+    invariant: str
+    detail: str
+    context: Optional[dict] = None
+
+    def record(self) -> dict:
+        out = {"invariant": self.invariant, "detail": self.detail}
+        if self.context:
+            out["context"] = dict(self.context)
+        return out
+
+
+def check_step_monotonic(
+    samples: Sequence[Tuple[float, int]],
+    rollback_to_steps: Sequence[int] = (),
+) -> List[Violation]:
+    """``model_step`` over response order must never decrease — except a
+    decrease landing exactly on an audited rollback target (the
+    monotonicity-exempt pinned demotion). ``samples`` are ``(t, step)``
+    in response order."""
+    violations: List[Violation] = []
+    allowed = set(int(s) for s in rollback_to_steps)
+    prev: Optional[int] = None
+    for t, step in samples:
+        step = int(step)
+        if prev is not None and step < prev and step not in allowed:
+            violations.append(
+                Violation(
+                    "step_monotonic",
+                    f"served step went backward {prev} -> {step} with no "
+                    "audited rollback to that step",
+                    {"t": t, "from_step": prev, "to_step": step},
+                )
+            )
+        prev = step
+    return violations
+
+
+def check_no_request_lost(
+    outcomes: Sequence[Dict[str, Any]],
+) -> List[Violation]:
+    """Every accepted request must RESOLVE — a success, or a typed
+    error the caller can act on. ``outcomes`` are
+    ``{"ok": bool, "error": str|None, "hung": bool}`` per accepted
+    request (the storm's prober fills them); a hung future is the
+    violation this checker exists for."""
+    violations = []
+    hung = [o for o in outcomes if o.get("hung")]
+    if hung:
+        violations.append(
+            Violation(
+                "no_request_lost",
+                f"{len(hung)} accepted request(s) never resolved "
+                "(future wedged past its deadline + slack)",
+                {"hung": len(hung), "total": len(outcomes)},
+            )
+        )
+    return violations
+
+
+def check_budget_one(compiles: Dict[str, int]) -> List[Violation]:
+    """Every named program's compile count must be <= 1 — the budget-1
+    receipts must hold with chaos armed (graftlint rule 19 is the
+    static half of this guarantee)."""
+    violations = []
+    for name, count in sorted(compiles.items()):
+        if int(count) > 1:
+            violations.append(
+                Violation(
+                    "budget_one",
+                    f"program {name!r} compiled {count} times under "
+                    "chaos (budget is 1)",
+                    {"program": name, "compiles": int(count)},
+                )
+            )
+    return violations
+
+
+# Events that terminate a candidate's journey vs. annotate it.
+_AUDIT_EVENTS = frozenset({
+    "promoted", "rejected", "rolled_back", "rollback_failed",
+    "promotion_deferred", "promotion_superseded", "curriculum_updated",
+    "curriculum_update_failed",
+})
+
+
+def check_audit_log(path: str | Path) -> List[Violation]:
+    """``promotions.jsonl`` must read back as a consistent state
+    machine: known events, promoted steps strictly ascending, every
+    rollback demoting to a step that actually served (a previously
+    promoted step), and no superseded candidate later claimed as
+    promoted."""
+    from marl_distributedformation_tpu.pipeline.promote import PromotionLog
+
+    violations: List[Violation] = []
+    try:
+        records = PromotionLog.read(path)
+    except Exception as e:  # noqa: BLE001 — unparseable log IS the trip
+        return [
+            Violation(
+                "audit_log", f"promotions.jsonl unreadable: {e!r}",
+                {"path": str(path)},
+            )
+        ]
+    promoted_steps: List[int] = []
+    superseded: set = set()
+    for i, rec in enumerate(records):
+        event = rec.get("event")
+        if event not in _AUDIT_EVENTS:
+            violations.append(
+                Violation(
+                    "audit_log",
+                    f"line {i}: unknown event {event!r}",
+                    {"line": i},
+                )
+            )
+            continue
+        step = rec.get("step")
+        if event == "promoted":
+            if step in superseded:
+                violations.append(
+                    Violation(
+                        "audit_log",
+                        f"line {i}: step {step} promoted AFTER being "
+                        "superseded — a never-served candidate became "
+                        "the baseline",
+                        {"line": i, "step": step},
+                    )
+                )
+            if promoted_steps and step <= promoted_steps[-1]:
+                violations.append(
+                    Violation(
+                        "audit_log",
+                        f"line {i}: promoted step {step} does not ascend "
+                        f"past {promoted_steps[-1]}",
+                        {"line": i, "step": step},
+                    )
+                )
+            promoted_steps.append(step)
+        elif event == "promotion_superseded":
+            superseded.add(step)
+        elif event == "rolled_back":
+            to_step = rec.get("to_step")
+            if to_step not in promoted_steps:
+                violations.append(
+                    Violation(
+                        "audit_log",
+                        f"line {i}: rolled back to step {to_step}, which "
+                        "was never promoted",
+                        {"line": i, "to_step": to_step},
+                    )
+                )
+    return violations
+
+
+def check_checkpoint_dir(log_dir: str | Path) -> List[Violation]:
+    """Crash consistency of a checkpoint directory: every DISCOVERABLE
+    file (the ``.msgpack``-suffixed names ``latest_checkpoint`` /
+    ``CheckpointDiscovery`` would serve) must carry a valid checksum
+    footer; torn ``.tmp`` files and quarantined (``.quarantined``)
+    files are invisible to discovery and therefore fine."""
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        CorruptCheckpointError,
+        read_checkpoint_payload,
+    )
+
+    violations: List[Violation] = []
+    log_dir = Path(log_dir)
+    if not log_dir.is_dir():
+        return violations
+    for p in sorted(log_dir.iterdir()):
+        if p.suffix != ".msgpack" or p.name.startswith("."):
+            continue  # invisible to discovery: torn tmp, quarantined
+        try:
+            read_checkpoint_payload(p, quarantine=False)
+        except CorruptCheckpointError as e:
+            violations.append(
+                Violation(
+                    "checkpoint_crash_consistency",
+                    f"discoverable checkpoint {p.name} is corrupt and "
+                    f"was never quarantined: {e}",
+                    {"path": str(p)},
+                )
+            )
+        except OSError as e:
+            violations.append(
+                Violation(
+                    "checkpoint_crash_consistency",
+                    f"discoverable checkpoint {p.name} unreadable: {e!r}",
+                    {"path": str(p)},
+                )
+            )
+    return violations
+
+
+def report_violations(
+    violations: Sequence[Violation],
+    plane: Optional[FaultPlane] = None,
+    trace_id: Optional[str] = None,
+) -> List[dict]:
+    """Alarm every violation: one ``chaos_violation`` incident per trip,
+    dumping the recent span history PLUS the armed/fired fault schedule
+    as structured flight-recorder context — the campaign's postmortem
+    writes itself. Returns the violation records (the report's
+    ``chaos_violations`` list). Never raises."""
+    from marl_distributedformation_tpu.obs import get_registry, get_tracer
+
+    plane = plane if plane is not None else get_fault_plane()
+    tracer = get_tracer()
+    registry = get_registry()
+    records = []
+    for v in violations:
+        records.append(v.record())
+        registry.counter("chaos_invariant_violations_total").inc()
+        tracer.incident(
+            "chaos_violation",
+            trace_id=trace_id,
+            invariant=v.invariant,
+            detail=v.detail,
+            violation_context=v.context or {},
+            fault_schedule_armed=plane.armed_record(),
+            fault_schedule_fired=plane.fired_record(),
+        )
+    return records

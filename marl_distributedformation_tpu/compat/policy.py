@@ -65,8 +65,15 @@ def infer_hidden(params: dict, policy: str) -> Optional[tuple]:
 
 
 def load_checkpoint_raw(path: str | Path) -> dict:
-    """Restore a checkpoint file into nested dicts without a template."""
-    return serialization.msgpack_restore(Path(path).read_bytes())
+    """Restore a checkpoint file into nested dicts without a template.
+    Validates the checksum footer: corrupt/truncated files are
+    quarantined and raise ``CorruptCheckpointError`` (utils.checkpoint)
+    instead of feeding damaged params to a gate or a fleet."""
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        msgpack_restore_file,
+    )
+
+    return msgpack_restore_file(path)
 
 
 class LoadedPolicy:

@@ -299,7 +299,14 @@ def export_sb3_state_dict(
     from flax import serialization
 
     src = Path(src)
-    raw = serialization.msgpack_restore(src.read_bytes())
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        msgpack_restore_file,
+    )
+
+    # quarantine=False: ``src`` is a CALLER-supplied file, not a
+    # trainer-owned checkpoint directory — a read-only conversion must
+    # never rename a user's input aside, just fail loudly.
+    raw = msgpack_restore_file(src, quarantine=False)
     policy = raw.get("policy", "MLPActorCritic")
     if policy != "MLPActorCritic":
         raise ValueError(

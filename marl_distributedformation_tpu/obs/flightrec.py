@@ -117,20 +117,27 @@ class FlightRecorder:
 
 
 def _jsonable(context: Dict[str, Any], depth: int = 2) -> Any:
-    """Best-effort JSON-safe copy of incident context (one level of
-    dict/list nesting preserved — the sentinel attaches a whole metrics
-    snapshot — reprs for anything exotic; the dump must always
-    serialize)."""
+    """Best-effort JSON-safe copy of incident context (bounded dict/
+    list nesting preserved — the sentinel attaches a whole metrics
+    snapshot, the chaos invariant checkers attach armed/fired fault
+    schedules as lists of dicts — reprs for anything exotic; the dump
+    must always serialize)."""
     out: Dict[str, Any] = {}
     for k, v in context.items():
-        if isinstance(v, (str, int, float, bool)) or v is None:
-            out[str(k)] = v
-        elif isinstance(v, dict) and depth > 0:
-            out[str(k)] = _jsonable(v, depth - 1)
-        elif isinstance(v, (list, tuple)) and depth > 0 and all(
-            isinstance(e, (str, int, float, bool)) or e is None for e in v
-        ):
-            out[str(k)] = list(v)
-        else:
-            out[str(k)] = repr(v)
+        out[str(k)] = _jsonable_value(v, depth)
     return out
+
+
+def _jsonable_value(v: Any, depth: int) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict) and depth > 0:
+        return _jsonable(v, depth - 1)
+    if isinstance(v, (list, tuple)) and depth > 0:
+        converted = [_jsonable_value(e, depth - 1) for e in v]
+        if all(
+            isinstance(e, (str, int, float, bool, dict)) or e is None
+            for e in converted
+        ):
+            return converted
+    return repr(v)

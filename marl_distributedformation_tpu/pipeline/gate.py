@@ -30,13 +30,15 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 import time
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+from marl_distributedformation_tpu.chaos.plane import fault_point
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.eval import episode_length
-from marl_distributedformation_tpu.obs import get_tracer
+from marl_distributedformation_tpu.obs import get_registry, get_tracer
 from marl_distributedformation_tpu.utils.checkpoint import checkpoint_step
 
 # Cells: {scenario: {"{severity:g}": {metric: float}}}
@@ -77,6 +79,14 @@ class GateConfig:
     adversarial_grid: int = 4
     adversarial_generations: int = 3
     adversarial_formations: int = 64
+    # -- eval deadline (chaos hardening) ---------------------------------
+    # A candidate wedged past this many seconds (a hung device op, an
+    # injected wedge) yields a ``gate_timeout`` verdict and the stream
+    # moves on — one stuck eval must not stall the always-learning loop
+    # forever. None/0 disables the deadline (the compiled program's
+    # FIRST eval includes its compile, so size this past the cold
+    # compile or run a warmup candidate first).
+    gate_timeout_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -100,6 +110,10 @@ class GateVerdict:
     eval_seconds: float
     falsifiers: Optional[List[dict]] = None
     adversary_compiles: int = 0
+    # The eval deadline fired: the candidate wedged past gate_timeout_s
+    # and was failed WITHOUT a completed eval (reasons[0] carries the
+    # ``gate_timeout:`` taxonomy).
+    timed_out: bool = False
 
     def record(self) -> dict:
         """The flat payload logged per candidate (PromotionLog adds
@@ -118,6 +132,8 @@ class GateVerdict:
         if self.falsifiers is not None:
             out["falsifiers"] = list(self.falsifiers)
             out["gate_adversary_compiles"] = self.adversary_compiles
+        if self.timed_out:
+            out["gate_timeout"] = True
         return out
 
 
@@ -232,6 +248,14 @@ class PromotionGate:
         self._baseline_step: Optional[int] = None
         self._baseline_clean: Optional[Dict[str, float]] = None
         self._baseline_cells: Optional[Cells] = None
+        # Serializes eval bodies. The deadline wrapper ABANDONS a
+        # wedged eval thread, but CPython cannot kill it — when it
+        # wakes it would otherwise race the next candidate's eval on
+        # shared gate state (the lazy program/adversary builds would
+        # double-compile, breaking the budget-1 receipt). Under the
+        # lock a still-wedged gate makes later candidates time out too
+        # (honest: the gate IS wedged) until the stuck thread drains.
+        self._eval_lock = threading.Lock()
         # Promoted-step history so a rollback can rebase the comparison
         # point without re-evaluating (bounded: serving history is short).
         self._history: Dict[int, Tuple[Dict[str, float], Cells]] = {}
@@ -253,7 +277,74 @@ class PromotionGate:
         architecture / non-finite candidates are failed verdicts with
         the reason recorded. ``trace_id`` labels the eval span (obs/)
         so the gate leg of a promotion trace carries the candidate's
-        identity."""
+        identity.
+
+        With ``gate_timeout_s`` set, the eval runs on a worker thread
+        under a deadline: a candidate wedged past it (hung device op,
+        injected wedge) yields a ``gate_timeout`` verdict and the
+        stream moves on — the wedged thread is abandoned (CPython
+        cannot kill it) and its late result discarded."""
+        path = Path(path)
+        timeout = self.config.gate_timeout_s
+        if not timeout:
+            return self._evaluate_inner(path, trace_id)
+        box: List[GateVerdict] = []
+        worker = threading.Thread(
+            target=lambda: box.append(self._evaluate_inner(path, trace_id)),
+            name="gate-eval",
+            daemon=True,
+        )
+        worker.start()
+        worker.join(float(timeout))
+        if box:
+            return box[0]
+        try:
+            step = checkpoint_step(path)
+        except ValueError:
+            step = -1
+        if worker.is_alive():
+            reason = (
+                f"gate_timeout: eval exceeded gate_timeout_s="
+                f"{float(timeout):g}s (wedged candidate; the stream "
+                "moves on, the stuck eval thread is abandoned)"
+            )
+        else:
+            # The worker died without producing a verdict — an
+            # uncontained (BaseException-grade) kill. Same taxonomy:
+            # this candidate never finished its eval.
+            reason = (
+                "gate_timeout: eval thread died before producing a "
+                "verdict (crashed candidate)"
+            )
+        get_registry().counter("pipeline_gate_timeouts_total").inc()
+        get_tracer().incident(
+            "gate_timeout", trace_id=trace_id, step=step, path=str(path),
+            gate_timeout_s=float(timeout),
+        )
+        return GateVerdict(
+            step=step,
+            path=str(path),
+            passed=False,
+            reasons=[reason],
+            clean={},
+            cells={},
+            baseline_step=self._baseline_step,
+            eval_compiles=(
+                self.program.compile_count if self.program else 0
+            ),
+            eval_seconds=float(timeout),
+            timed_out=True,
+        )
+
+    def _evaluate_inner(
+        self, path: Path, trace_id: Optional[str] = None
+    ) -> GateVerdict:
+        with self._eval_lock:
+            return self._evaluate_unlocked(path, trace_id)
+
+    def _evaluate_unlocked(
+        self, path: Path, trace_id: Optional[str] = None
+    ) -> GateVerdict:
         from marl_distributedformation_tpu.compat.policy import LoadedPolicy
         from marl_distributedformation_tpu.scenarios.matrix import (
             MatrixProgram,
@@ -281,6 +372,10 @@ class PromotionGate:
                 eval_seconds=0.0,
             )
         try:
+            # The chaos seam for the whole eval body: a wedge here (on
+            # the deadline wrapper's worker thread) exercises
+            # gate_timeout_s; a raise is a contained rejected verdict.
+            fault_point("gate.eval", path=path)
             pol = LoadedPolicy.from_checkpoint(
                 path,
                 act_dim=self.env_params.act_dim,

@@ -26,6 +26,7 @@ import time
 from pathlib import Path
 from typing import Any, List, Optional
 
+from marl_distributedformation_tpu.chaos.plane import fault_point
 from marl_distributedformation_tpu.utils.checkpoint import (
     CheckpointDiscovery,
 )
@@ -64,6 +65,7 @@ class CheckpointStream:
     def poll(self) -> List[Path]:
         """New checkpoints since the last poll, ascending step order.
         Non-blocking."""
+        fault_point("stream.poll")
         return self._discovery.poll_new()
 
     def wait(self, timeout_s: float) -> List[Path]:

@@ -36,6 +36,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from marl_distributedformation_tpu.chaos.plane import fault_point
+from marl_distributedformation_tpu.chaos.watchdog import Heartbeat
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.obs import (
     get_registry,
@@ -154,6 +156,14 @@ class AlwaysLearningPipeline:
         self._good: List[PromotionRecord] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Self-healing supervision (chaos/watchdog.py): the run loop
+        # heartbeats every iteration; a LaneWatchdog watching this lane
+        # restarts it on wedge/death via restart_loop(). The generation
+        # token is how a wedged thread is ABANDONED — it exits at its
+        # next generation check instead of racing its replacement.
+        self.heartbeat = Heartbeat("pipeline_loop")
+        self._generation = 0
+        self._interval_s = 0.25
 
     # -- wiring ----------------------------------------------------------
 
@@ -558,16 +568,47 @@ class AlwaysLearningPipeline:
         if self._thread is not None:
             return self
         self._stop.clear()
+        self._interval_s = interval_s
+        self._start_loop()
+        return self
+
+    def _start_loop(self) -> None:
+        """Spawn one generation of the supervision loop. The generation
+        token gates every blocking boundary: a superseded (restarted-
+        over) thread exits before touching the gate or the pending
+        queue again, so a watchdog restart can never double-process a
+        candidate or double-compile the eval program."""
+        self._generation += 1
+        gen = self._generation
+        interval_s = self._interval_s
+
+        def live() -> bool:
+            return not self._stop.is_set() and self._generation == gen
 
         def loop() -> None:
-            while not self._stop.is_set():
+            while live():
                 # A transient failure (full disk during publish/log, a
                 # checkpoint pruned mid-judgment) must not silently kill
-                # the control plane — record it and keep supervising.
+                # the control plane — record it and keep supervising. A
+                # SimulatedCrash (BaseException) is NOT contained: it
+                # kills this lane like a real kill and the watchdog owns
+                # the restart.
                 try:
+                    self.heartbeat.beat()
+                    fault_point("pipeline.poll")
+                    if not live():
+                        return  # restarted over while wedged: abandon
                     self._retry_deferred()
                     self._pending.extend(self.stream.wait(interval_s))
-                    while self._pending and not self._stop.is_set():
+                    while self._pending and live():
+                        # Beat per candidate: a healthy lane working
+                        # through a deep backlog must not read as
+                        # wedged. (One eval LONGER than the watchdog's
+                        # wedge_timeout_s still trips — size the
+                        # timeout past a gate eval; the gate's eval
+                        # lock keeps an overlapping restart from
+                        # double-compiling either way.)
+                        self.heartbeat.beat()
                         self.process_candidate(self._pending.pop(0))
                     self.check_rollback()
                 except Exception as e:  # noqa: BLE001
@@ -576,10 +617,25 @@ class AlwaysLearningPipeline:
                     self._stop.wait(interval_s)
 
         self._thread = threading.Thread(
-            target=loop, name="always-learning-pipeline", daemon=True
+            target=loop,
+            name=f"always-learning-pipeline-g{gen}",
+            daemon=True,
         )
         self._thread.start()
-        return self
+
+    def loop_alive(self) -> bool:
+        """Liveness probe for the watchdog: is the CURRENT generation's
+        thread running?"""
+        return self._thread is not None and self._thread.is_alive()
+
+    def restart_loop(self) -> None:
+        """Abandon-and-replace the supervision lane (the watchdog's
+        restart hook): bump the generation — the old thread, wedged or
+        dead, exits at its next generation check — and start a fresh
+        one. No-op after stop()."""
+        if self._stop.is_set():
+            return
+        self._start_loop()
 
     def stop(self) -> None:
         if self._thread is None:

@@ -22,8 +22,9 @@ client code talks to one engine or a whole fleet.
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
@@ -39,6 +40,7 @@ def backoff_s(
     retry_after_s: float,
     base_s: float = 0.05,
     cap_s: float = 2.0,
+    jitter: Optional[Callable[[], float]] = None,
 ) -> float:
     """Capped-exponential backoff that honors the server's hint.
 
@@ -50,10 +52,21 @@ def backoff_s(
     saves the server when its estimate is too optimistic: a queue that
     keeps rejecting at a tiny ``retry_after_s`` still sees this client
     back off harder every attempt.
+
+    ``jitter`` (a zero-arg callable returning uniform [0, 1)) turns the
+    exponential leg into FULL JITTER: the sleep becomes a random
+    fraction of the capped-exponential delay, still floored at the
+    server's ``retry_after_s``. Without it, a fleet-wide 429 or a
+    failover storm synchronizes every client's clock — they all sleep
+    the SAME deterministic delay and stampede back in lockstep, re-
+    rejecting each other forever; spreading retries uniformly over the
+    window drains the herd in one pass. ``None`` keeps the
+    deterministic delay (single-caller tools, tests).
     """
-    return max(
-        float(retry_after_s), min(cap_s, base_s * (2.0 ** attempt))
-    )
+    exp = min(cap_s, base_s * (2.0 ** attempt))
+    if jitter is not None:
+        exp *= jitter()
+    return max(float(retry_after_s), exp)
 
 
 class ServingClient:
@@ -63,11 +76,19 @@ class ServingClient:
         max_retries: int = 3,
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 2.0,
+        jitter: bool = True,
+        rng: Optional[random.Random] = None,
     ) -> None:
         self.scheduler = scheduler
         self.max_retries = max_retries
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
+        # Full-jitter retries ship ON: a fleet of clients hitting the
+        # same 429 must spread over the backoff window, not stampede
+        # back in sync (backoff_s docstring). ``rng`` is injectable so
+        # the distribution is pinnable in tests.
+        self.jitter = bool(jitter)
+        self._rng = rng if rng is not None else random.Random()
 
     def predict(
         self,
@@ -129,6 +150,7 @@ class ServingClient:
                         e.retry_after_s,
                         self.backoff_base_s,
                         self.backoff_cap_s,
+                        jitter=self._rng.random if self.jitter else None,
                     )
                 )
         raise AssertionError("unreachable")  # pragma: no cover

@@ -55,6 +55,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from marl_distributedformation_tpu.chaos.plane import fault_point
 from marl_distributedformation_tpu.obs import (
     PROMETHEUS_CONTENT_TYPE,
     TRACE_HEADER,
@@ -178,6 +179,18 @@ def _make_handler(router: FleetRouter):
                 sanitize_trace_id(self.headers.get(TRACE_HEADER))
                 or new_trace_id()
             )
+            try:
+                # Chaos seam: an injected handler fault degrades to a
+                # typed 500 (the client's retry loop owns it) — never a
+                # dropped connection, never a dead frontend thread.
+                fault_point("frontend.handler")
+            except Exception as e:  # noqa: BLE001 — injected by design
+                self._reply(
+                    500,
+                    {"error": f"injected fault: {e}"},
+                    trace_id=trace_id,
+                )
+                return
             if self.path != "/v1/act":
                 self._reply(
                     404,

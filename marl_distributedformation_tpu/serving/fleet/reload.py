@@ -45,6 +45,7 @@ from collections import deque
 from pathlib import Path
 from typing import Any, Deque, Optional, Tuple
 
+from marl_distributedformation_tpu.chaos.plane import fault_point
 from marl_distributedformation_tpu.obs import get_tracer
 from marl_distributedformation_tpu.utils.checkpoint import (
     CheckpointDiscovery,
@@ -257,6 +258,7 @@ class FleetReloadCoordinator:
             ]
         barriers = [r.registry.batch_lock for r, _ in staged]
         held = []
+        installed = []
         wedged_replica = None
         try:
             # Close every gate FIRST: workers finish their current
@@ -273,6 +275,7 @@ class FleetReloadCoordinator:
             for b in barriers:
                 b.close()
             for i, b in enumerate(barriers):
+                fault_point("fleet.barrier")
                 t_acq = time.perf_counter()
                 acquired = b.acquire(timeout=self.commit_timeout_s)
                 tracer.add_span(
@@ -301,9 +304,30 @@ class FleetReloadCoordinator:
                 replicas=len(staged),
             ):
                 for r, params in staged:
+                    prev = r.registry.active()
+                    fault_point("registry.swap")
                     r.registry.install(params, step)
+                    installed.append((r, prev))
                 self._fleet_step = step
                 self.swap_count += 1
+        except Exception as e:  # noqa: BLE001 — contain + untear
+            # A failure mid-commit (an injected fault, a broken
+            # registry) must not leave a TORN swap: some replicas on
+            # the new step, others on the old, is exactly the
+            # inconsistency the batch barrier exists to prevent. Roll
+            # every installed replica back to its previous cell (all
+            # locks are still held — the fleet never serves the torn
+            # state), record, and keep serving the old step everywhere.
+            for r, (prev_params, prev_step) in reversed(installed):
+                r.registry.install(prev_params, prev_step)
+            self.load_errors.append(
+                (
+                    str(path),
+                    f"commit aborted mid-swap and rolled back: {e!r}; "
+                    "old step keeps serving fleet-wide",
+                )
+            )
+            return False
         finally:
             for b in reversed(held):
                 b.release()

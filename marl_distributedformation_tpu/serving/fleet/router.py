@@ -462,6 +462,14 @@ class FleetRouter:
             replica.broken_at = time.monotonic()
             replica.break_reason = reason
         self.metrics.record_break()
+        if not replica.scheduler.alive:
+            # A DEAD worker's queued futures would wedge their callers
+            # forever (nothing will ever dispatch them). Fail them with
+            # SchedulerStopped now — the failover callbacks re-route
+            # them to surviving replicas like any replica fault. Guarded
+            # on liveness: a live worker (RetraceError break) still owns
+            # and drains its own queue.
+            replica.scheduler.fail_queued()
         # Circuit break = an incident: snapshot the trace ring while the
         # pre-break dispatch history is still in it (flight recorder,
         # when configured) — outside the health lock, it does file IO.

@@ -52,6 +52,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from marl_distributedformation_tpu.chaos.plane import fault_point
 from marl_distributedformation_tpu.obs import get_tracer
 from marl_distributedformation_tpu.serving.engine import BucketedPolicyEngine
 from marl_distributedformation_tpu.serving.metrics import ServingMetrics
@@ -325,6 +326,19 @@ class MicroBatchScheduler:
         self._thread.start()
         return self
 
+    def restart(self) -> None:
+        """Replace a DEAD worker thread (the watchdog's fleet lane): a
+        crashed worker leaves ``_thread`` set but not alive — clear it
+        and spawn a fresh one. No-op while the worker is alive (a live
+        worker owns its queue) and after an explicit ``stop()`` (a
+        stopped scheduler stays stopped)."""
+        if self._stop.is_set():
+            return
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = None
+        self.start()
+
     def stop(self) -> None:
         if self._thread is None:
             return
@@ -332,6 +346,15 @@ class MicroBatchScheduler:
         self._thread.join(timeout=30.0)
         self._thread = None
         # Fail anything still queued — no silent dropped futures.
+        self._drain_stopped_queue()
+
+    def fail_queued(self) -> None:
+        """Fail every queued future with :class:`SchedulerStopped` — the
+        router's DEAD-WORKER cleanup. A worker that crashed (rather than
+        being stopped) leaves its queue orphaned; without this drain
+        those callers wedge forever, with it their futures fail over to
+        surviving replicas like any replica fault. Only call when the
+        worker is not alive (a live worker owns its queue)."""
         self._drain_stopped_queue()
 
     def _drain_stopped_queue(self) -> None:
@@ -371,6 +394,11 @@ class MicroBatchScheduler:
 
     def _serve_loop(self) -> None:
         while not self._stop.is_set():
+            # Chaos seam: a crash here is a WORKER DEATH — it escapes to
+            # _run (incident + thread exit) with no request in hand, and
+            # the router's circuit breaker + dead-worker queue drain own
+            # the recovery. Deliberately outside the per-batch backstop.
+            fault_point("scheduler.dispatch")
             try:
                 first = self._queue.get(timeout=0.05)
             except queue.Empty:

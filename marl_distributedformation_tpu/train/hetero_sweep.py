@@ -783,7 +783,11 @@ class HeteroSweepTrainer:
                 "fresh"
             )
             return
-        raw = serialization.msgpack_restore(Path(path).read_bytes())
+        from marl_distributedformation_tpu.utils.checkpoint import (
+            msgpack_restore_file,
+        )
+
+        raw = msgpack_restore_file(path)
         ident = {
             "policy": self.model.__class__.__name__,
             "num_seeds": self.num_seeds,

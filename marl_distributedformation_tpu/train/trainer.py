@@ -42,10 +42,9 @@ from marl_distributedformation_tpu.utils import (
     Throughput,
     checkpoint_path,
     device_snapshot,
-    latest_checkpoint,
     own_restored,
     repo_root,
-    restore_checkpoint_partial,
+    restore_latest_partial,
     save_checkpoint,
 )
 
@@ -1118,18 +1117,21 @@ class Trainer:
         if self._multihost:
             self._try_resume_multihost()
             return
-        path = latest_checkpoint(self.log_dir)
-        if path is None:
-            return
         # Partial restore: a multi-host-written (learner-only) checkpoint
         # resumes fine single-host — env state just starts fresh. A
         # converted SB3 checkpoint (compat/sb3_import.py) carries params
         # only; missing learner pieces (opt_state, key) keep their fresh
         # values — a warm-started fine-tune re-estimates Adam moments
-        # within a few iterations.
-        restored = restore_checkpoint_partial(
-            path, self._checkpoint_target()
+        # within a few iterations. Corrupt/truncated files are
+        # quarantined and the walk-back resumes from the newest VALID
+        # checkpoint (utils.restore_latest_partial) — a crashed writer
+        # costs one checkpoint, never a wedged resume.
+        found = restore_latest_partial(
+            self.log_dir, self._checkpoint_target()
         )
+        if found is None:
+            return
+        path, restored = found
         # Owning copies BEFORE the donating dispatch sees this state
         # (utils.own_restored: msgpack leaves can alias the checkpoint
         # bytes, and donating an aliased buffer is a use-after-free on

@@ -13,15 +13,20 @@ from marl_distributedformation_tpu.utils.config import (  # noqa: F401
 from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
     AsyncCheckpointWriter,
     CheckpointDiscovery,
+    CorruptCheckpointError,
     broadcast_restore,
     checkpoint_path,
     checkpoint_step,
     device_snapshot,
     latest_checkpoint,
     latest_sweep_state,
+    msgpack_restore_file,
     own_restored,
+    quarantine_checkpoint,
+    read_checkpoint_payload,
     restore_checkpoint,
     restore_checkpoint_partial,
+    restore_latest_partial,
     save_checkpoint,
     save_sweep_state,
     sweep_state_path,

@@ -14,14 +14,23 @@ file — host-side, TPU-independent, and restorable on any backend.
 
 from __future__ import annotations
 
+import json
 import os
+import random
 import re
+import struct
 import threading
 import time
+import zlib
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from flax import serialization
+
+from marl_distributedformation_tpu.chaos.plane import (
+    SimulatedCrash,
+    fault_point,
+)
 
 _STEP_RE = re.compile(r"rl_model_(\d+)_steps")
 # Population-sweep state files live beside member dirs under the sweep's
@@ -74,6 +83,128 @@ def save_checkpoint(
     return path if on_coordinator else None
 
 
+# ----------------------------------------------------------------------
+# Crash-consistent format: payload + checksum footer
+# ----------------------------------------------------------------------
+#
+# The rename-is-publication protocol makes a torn WRITE invisible, but
+# it cannot see silent media damage or a truncation that happens after
+# the rename (a crashed fsync-less host, a bad sector, an injected
+# bit-flip in a chaos campaign). Every checkpoint therefore carries a
+# 20-byte footer: crc32(payload) + payload length + magic, validated on
+# every read. Footer-less files (pre-chaos-plane checkpoints, foreign
+# msgpack files) read as legacy payloads unchanged, so THIS reader
+# handles both formats. The converse does not hold: a plain
+# ``msgpack_restore(read_bytes())`` from a pre-footer release chokes on
+# the trailing 20 bytes — rolling the READER back past this change
+# while a new trainer keeps writing is the one unsupported direction
+# (roll the writer back too, or strip footers with
+# read_checkpoint_payload first).
+
+_CKPT_MAGIC = b"MARLCKPT"
+_FOOTER = struct.Struct("<Iq8s")  # crc32, payload length, magic
+
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint whose bytes fail validation (checksum mismatch,
+    truncation past the footer, undecodable msgpack) — damage, not an
+    architecture mismatch."""
+
+
+def _with_footer(payload: bytes) -> bytes:
+    return payload + _FOOTER.pack(
+        zlib.crc32(payload) & 0xFFFFFFFF, len(payload), _CKPT_MAGIC
+    )
+
+
+def _strip_footer(data: bytes, origin: str) -> bytes:
+    """Validate + strip the checksum footer; legacy (footer-less) bytes
+    pass through whole. Raises :class:`CorruptCheckpointError` on a
+    failed check."""
+    if len(data) < _FOOTER.size or data[-8:] != _CKPT_MAGIC:
+        return data  # legacy file: no footer to validate
+    crc, length, _ = _FOOTER.unpack(data[-_FOOTER.size:])
+    payload = data[: -_FOOTER.size]
+    if length != len(payload):
+        raise CorruptCheckpointError(
+            f"checkpoint {origin}: footer says {length} payload bytes "
+            f"but {len(payload)} are present (truncated write?)"
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise CorruptCheckpointError(
+            f"checkpoint {origin}: payload checksum mismatch "
+            "(bit rot or torn write)"
+        )
+    return payload
+
+
+def quarantine_checkpoint(path: str | Path, reason: str) -> Optional[Path]:
+    """Move a corrupt checkpoint ASIDE instead of leaving it to wedge
+    every future resume/reload: renamed to ``{name}.quarantined`` (the
+    suffix is no longer ``.msgpack``, so ``latest_checkpoint`` and
+    ``CheckpointDiscovery`` can never serve it), audit-logged to
+    ``quarantine.jsonl`` beside it, counted and flight-recorded.
+    Best-effort — returns the quarantine path or None; never raises
+    (quarantine runs on already-failing paths)."""
+    from marl_distributedformation_tpu.obs import get_registry, get_tracer
+
+    path = Path(path)
+    target = path.with_name(path.name + ".quarantined")
+    try:
+        path.replace(target)
+    except OSError:
+        target = None
+    try:
+        with open(path.parent / "quarantine.jsonl", "a") as f:
+            f.write(json.dumps({
+                "time": round(time.time(), 3),
+                "file": path.name,
+                "quarantined_as": target.name if target else None,
+                "reason": str(reason)[:300],
+            }) + "\n")
+    except OSError:
+        pass
+    get_registry().counter("checkpoint_quarantined_total").inc()
+    get_tracer().incident(
+        "checkpoint_quarantined", path=str(path), reason=str(reason)[:300]
+    )
+    return target
+
+
+def read_checkpoint_payload(
+    path: str | Path, quarantine: bool = True
+) -> bytes:
+    """Checkpoint bytes with the checksum footer validated and
+    stripped. A failed check quarantines the file (unless told not to)
+    and raises :class:`CorruptCheckpointError` — corruption is detected
+    HERE, at read time, never as a wedged restore downstream."""
+    path = Path(path)
+    data = path.read_bytes()
+    try:
+        return _strip_footer(data, origin=str(path))
+    except CorruptCheckpointError as e:
+        if quarantine:
+            quarantine_checkpoint(path, str(e))
+        raise
+
+
+def msgpack_restore_file(path: str | Path, quarantine: bool = True) -> Any:
+    """``msgpack_restore`` over a footer-validated checkpoint file —
+    THE way to read raw checkpoint state (every reader shares the
+    validation + quarantine policy). Undecodable msgpack is corruption
+    too (a legacy-format truncation has no footer to fail)."""
+    payload = read_checkpoint_payload(path, quarantine=quarantine)
+    try:
+        return serialization.msgpack_restore(payload)
+    except Exception as e:  # noqa: BLE001 — any decode failure is damage
+        err = CorruptCheckpointError(
+            f"checkpoint {path}: undecodable msgpack payload: {e!r}"
+        )
+        if quarantine:
+            quarantine_checkpoint(path, str(err))
+        raise err from e
+
+
 def _write_atomic(path: Path, target: Any) -> None:
     import jax
 
@@ -86,8 +217,11 @@ def _write_atomic(path: Path, target: Any) -> None:
     # device->host round-trips can dominate the training loop (the
     # reference-parity save_freq checkpoints every iteration).
     target = jax.device_get(target)
-    tmp.write_bytes(serialization.to_bytes(target))
+    fault_point("checkpoint.write", path=tmp)
+    tmp.write_bytes(_with_footer(serialization.to_bytes(target)))
+    fault_point("checkpoint.pre_rename", path=tmp)
     tmp.replace(path)  # atomic: no torn checkpoints (SURVEY.md §5)
+    fault_point("checkpoint.post_rename", path=path)
 
 
 def own_restored(tree: Any) -> Any:
@@ -146,16 +280,38 @@ class AsyncCheckpointWriter:
 
     At most ONE write is in flight — ``submit`` joins the previous write
     first, which bounds snapshot memory to one checkpoint and keeps the
-    on-disk step order monotonic. A failed write surfaces as
-    ``RuntimeError`` on the next ``submit``/``close`` (never silently);
-    the torn-write invariant is :func:`_write_atomic`'s — a crash at any
-    point leaves only a dot-prefixed ``.tmp`` file that
-    :func:`latest_checkpoint` can never pick up.
+    on-disk step order monotonic. The torn-write invariant is
+    :func:`_write_atomic`'s — a crash at any point leaves only a
+    dot-prefixed ``.tmp`` file that :func:`latest_checkpoint` can never
+    pick up.
+
+    **IO failures degrade, they never kill training.** A full disk
+    (ENOSPC), a flaky mount, or an injected crash used to surface as
+    ``RuntimeError`` on the next ``submit`` — which turned one missed
+    checkpoint into a dead always-learning run. Now an ``OSError`` gets
+    ``io_retries`` bounded jittered retries (the write callable is
+    idempotent: tmp + rename), and an exhausted budget — or a
+    :class:`~..chaos.plane.SimulatedCrash` kill of the write — is
+    SKIPPED with a full audit trail (``checkpoint_writes_skipped_total``,
+    a ``checkpoint_write_skipped`` flight record) while training
+    continues; the next save_freq boundary writes the next checkpoint.
+    Non-IO failures (a serialization bug, a bad snapshot) still surface
+    as ``RuntimeError`` on the next ``submit``/``close`` — those are
+    program errors, not weather.
     """
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        io_retries: int = 3,
+        io_backoff_s: float = 0.05,
+        rng: Optional[random.Random] = None,
+    ) -> None:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self.io_retries = max(0, int(io_retries))
+        self.io_backoff_s = float(io_backoff_s)
+        self.writes_skipped = 0
+        self._rng = rng if rng is not None else random.Random()
 
     def submit(
         self, path: str | Path, target: Any, on_done: Any = None
@@ -190,6 +346,7 @@ class AsyncCheckpointWriter:
         one write in flight, errors surface on the next submit/close."""
         from marl_distributedformation_tpu.obs.metrics import get_registry
 
+        fault_point("ckpt_writer.submit")
         self.wait()
         # Live-metrics plane: single-flight writer, so depth is 0 or 1 —
         # a depth stuck at 1 means training outruns checkpoint IO.
@@ -206,7 +363,30 @@ class AsyncCheckpointWriter:
 
         t0 = time.perf_counter()
         try:
-            write_fn()
+            attempt = 0
+            while True:
+                try:
+                    write_fn()
+                    break
+                except OSError as e:
+                    # Disk weather (ENOSPC, a flaky mount): bounded
+                    # jittered retries — write_fn is idempotent (tmp +
+                    # rename) — then skip-with-audit. Never a dead run.
+                    attempt += 1
+                    if attempt > self.io_retries:
+                        self._skip(e)
+                        return
+                    time.sleep(
+                        self.io_backoff_s
+                        * (2.0 ** (attempt - 1))
+                        * self._rng.uniform(0.5, 1.5)
+                    )
+                except SimulatedCrash as e:
+                    # An injected kill of this write: the checkpoint is
+                    # simply lost (exactly what a real crash costs) —
+                    # audit it and keep the training run alive.
+                    self._skip(e)
+                    return
             registry = get_registry()
             registry.histogram("checkpoint_write_seconds").observe(
                 time.perf_counter() - t0
@@ -216,6 +396,20 @@ class AsyncCheckpointWriter:
             self._error = e
         finally:
             get_registry().gauge("checkpoint_queue_depth").set(0.0)
+
+    def _skip(self, error: BaseException) -> None:
+        """Audit a degraded (skipped) write: counter + flight record.
+        The run stays alive; the next save boundary tries again."""
+        from marl_distributedformation_tpu.obs import get_registry, get_tracer
+
+        self.writes_skipped += 1
+        get_registry().counter("checkpoint_writes_skipped_total").inc()
+        get_tracer().incident(
+            "checkpoint_write_skipped",
+            error=repr(error)[:300],
+            retries=self.io_retries,
+            writes_skipped=self.writes_skipped,
+        )
 
     def wait(self) -> None:
         """Join the in-flight write (if any); re-raise its failure."""
@@ -391,8 +585,13 @@ class CheckpointDiscovery:
 
 def restore_checkpoint(path: str | Path, template: Any) -> Any:
     """Restore a pytree serialized by ``save_checkpoint`` into the structure
-    of ``template`` (same-treedef pytree with correctly-shaped leaves)."""
-    return serialization.from_bytes(template, Path(path).read_bytes())
+    of ``template`` (same-treedef pytree with correctly-shaped leaves).
+    The checksum footer is validated first: damaged bytes are
+    quarantined and raise :class:`CorruptCheckpointError` here instead
+    of wedging the caller downstream."""
+    return serialization.from_state_dict(
+        template, msgpack_restore_file(path)
+    )
 
 
 def restore_checkpoint_partial(
@@ -412,9 +611,36 @@ def restore_checkpoint_partial(
     at restore time — not a shape crash later inside a compiled train step
     or serving act function.
     """
-    raw = serialization.msgpack_restore(Path(path).read_bytes())
+    raw = msgpack_restore_file(path)
     assert isinstance(raw, dict), f"checkpoint at {path} is not a dict"
     return restore_state_dict_partial(raw, template, origin=str(path))
+
+
+def restore_latest_partial(
+    log_dir: str | Path, template: dict
+) -> Optional[tuple]:
+    """Resume from the newest VALID checkpoint: walk the discovery
+    order newest-first, quarantining corrupt/truncated files as they
+    are found, until one restores — a crashed writer or a bad sector
+    costs one checkpoint of progress, never a wedged resume. Returns
+    ``(path, restored)`` or None when no restorable checkpoint exists.
+    Architecture mismatches still raise (that is a config error, not
+    damage)."""
+    while True:
+        path = latest_checkpoint(log_dir)
+        if path is None:
+            return None
+        try:
+            return path, restore_checkpoint_partial(path, template)
+        except CorruptCheckpointError:
+            # Reader already quarantined the file (renamed aside), so
+            # the next latest_checkpoint scan steps down one. If the
+            # rename FAILED (read-only remount, permissions), the same
+            # corrupt path stays discoverable forever — surface the
+            # corruption instead of spinning (and flooding the flight
+            # recorder with one incident per iteration).
+            if path.exists():
+                raise
 
 
 def restore_state_dict_partial(

@@ -66,6 +66,18 @@ PIPELINE_KEYS = (
     "gate_adversarial_generations",
     "gate_adversarial_formations",
     "feedback_rollouts",
+    # gate-eval deadline (chaos hardening, docs/chaos.md)
+    "gate_timeout_s",
+    # self-healing supervision (chaos/watchdog.py, docs/chaos.md)
+    "watchdog",
+    "watchdog_wedge_timeout_s",
+    "watchdog_backoff_s",
+    "watchdog_backoff_cap_s",
+    # chaos plane (chaos/, docs/chaos.md): arm a seeded fault campaign
+    # against THIS live run — dev/staging resilience drills.
+    "chaos",
+    "chaos_seed",
+    "chaos_faults",
     # fleet
     "pipeline_replicas",
     "pipeline_buckets",
@@ -142,6 +154,14 @@ def _gate_config(cfg):
         ),
         adversarial_formations=int(
             cfg.get("gate_adversarial_formations", 64)
+        ),
+        # The eval deadline: size past the cold compile (the FIRST eval
+        # includes it) or leave None; a wedged candidate then yields a
+        # ``gate_timeout`` verdict instead of stalling the loop.
+        gate_timeout_s=(
+            float(cfg["gate_timeout_s"])
+            if cfg.get("gate_timeout_s") is not None
+            else None
         ),
     )
 
@@ -315,6 +335,7 @@ def main(argv=None) -> dict:
     report: dict = {"name": str(cfg.name)}
     router = None
     frontend = None
+    watchdog = None
     try:
         if not pipeline.wait_first_promotion(
             timeout_s=max(deadline - time.time(), 1.0)
@@ -353,9 +374,58 @@ def main(argv=None) -> dict:
         if monitor is not None:
             pipeline.attach_monitor(monitor)
 
+        # Self-healing supervision (chaos/watchdog.py): the watchdog
+        # restarts a crashed replica worker and the router's half-open
+        # probe readmits it — the fleet regrows to full width instead
+        # of bleeding replicas. (The pipeline lane here IS this main
+        # thread, so only the fleet lanes are watchdogged; the
+        # background-loop mode — pipeline.run() — also gets the
+        # pipeline lane via watchdog.watch_pipeline.)
+        if bool(cfg.get("watchdog", True)):
+            from marl_distributedformation_tpu.chaos import LaneWatchdog
+
+            watchdog = LaneWatchdog(
+                wedge_timeout_s=float(
+                    cfg.get("watchdog_wedge_timeout_s", 30.0)
+                ),
+                backoff_base_s=float(cfg.get("watchdog_backoff_s", 0.5)),
+                backoff_cap_s=float(
+                    cfg.get("watchdog_backoff_cap_s", 30.0)
+                ),
+            )
+            watchdog.watch_fleet(router)
+            watchdog.start()
+
+        # Chaos drill (chaos/, docs/chaos.md): arm a seeded fault
+        # campaign against THIS live run. The schedule is a pure
+        # function of chaos_seed, so a drill that trips an invariant
+        # replays bit-identically (scripts/chaos_storm.py is the
+        # self-contained harness; this knob storms the real run).
+        if bool(cfg.get("chaos", False)):
+            from marl_distributedformation_tpu.chaos import (
+                FaultSchedule,
+                get_fault_plane,
+            )
+
+            plane = get_fault_plane()
+            plane.arm(
+                FaultSchedule.from_seed(
+                    int(cfg.get("chaos_seed", 0)),
+                    faults=int(cfg.get("chaos_faults", 25)),
+                )
+            )
+            plane.enabled = True
+            print(
+                f"[always] chaos armed: {plane.pending()} faults, "
+                f"seed {int(cfg.get('chaos_seed', 0))}",
+                file=sys.stderr,
+            )
+
         # Supervision loop: drain candidates while the trainer runs,
-        # then drain the tail after it finishes.
+        # then drain the tail after it finishes. The loop heartbeats so
+        # `pipeline_loop_heartbeat_age_s` is scrapeable liveness.
         while time.time() < deadline:
+            pipeline.heartbeat.beat()
             processed = pipeline.poll_once()
             if sentinel is not None:
                 # Refresh the fleet families first (FleetMetrics
@@ -396,6 +466,23 @@ def main(argv=None) -> dict:
             report["telemetry_url"] = report_telemetry_url
         report["pipeline_replicas"] = replicas
         report["fleet_swap_count"] = coordinator.swap_count
+        if watchdog is not None:
+            report["lane_restarts"] = watchdog.restarts_total()
+        from marl_distributedformation_tpu.chaos import get_fault_plane
+        from marl_distributedformation_tpu.obs import get_registry
+
+        if get_fault_plane().fired:
+            report["chaos_faults_fired"] = len(
+                get_fault_plane().fired_record()
+            )
+        live = get_registry().snapshot()
+        for key in (
+            "checkpoint_writes_skipped_total",
+            "checkpoint_quarantined_total",
+            "pipeline_gate_timeouts_total",
+        ):
+            if live.get(key):
+                report[key] = int(live[key])
         report["verified_served_steps"] = served_steps
         report["train_alive"] = train_thread.is_alive()
         if train_error:
@@ -410,6 +497,11 @@ def main(argv=None) -> dict:
             default=0,
         )
     finally:
+        from marl_distributedformation_tpu.chaos import get_fault_plane
+
+        get_fault_plane().enabled = False
+        if watchdog is not None:
+            watchdog.stop()
         if telemetry is not None:
             telemetry.stop()
         if frontend is not None:

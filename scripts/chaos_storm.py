@@ -1,0 +1,536 @@
+#!/usr/bin/env python
+"""Chaos storm: trainer -> gate -> fleet under a seeded fault campaign.
+
+One command runs the whole always-learning loop at tiny scale while a
+seeded :class:`chaos.FaultSchedule` injects crashes, wedges, checkpoint
+corruption, ENOSPC, and delays at the host seams the code declares
+(``chaos.INJECTION_POINTS``), then checks every cross-PR invariant
+(step monotonicity, no-request-lost, budget-1 receipts, audit-log and
+checkpoint-dir consistency) and reports MTTR + violations as ONE JSON
+line:
+
+    python scripts/chaos_storm.py --seed 0 --faults 25
+
+The campaign is DETERMINISTIC from its seed: ``--print-schedule`` emits
+the armed fault schedule (a pure function of the CLI args) without
+running anything, and the report's ``deterministic`` section replays
+bit-identically — a failing campaign is re-runnable, not an anecdote.
+Wall-clock fields (``chaos_mttr_s``, rates) are measurements and live
+OUTSIDE that section.
+
+Phases:
+
+1. **train** — a tiny fused-scan Trainer writes checkpoints through the
+   AsyncCheckpointWriter while crash/ENOSPC/corruption faults hit the
+   write path; training must SURVIVE (skip-with-audit) and leave a
+   crash-consistent directory.
+2. **resume** — ``restore_latest_partial`` walks back over quarantined
+   damage to the newest valid checkpoint.
+3. **serve** — bootstrap the promotion pipeline, attach a 2-replica
+   fleet + LaneWatchdog, then run the supervised loop under the
+   pipeline/serving half of the schedule while a prober measures
+   recovery (kill -> first served response = MTTR).
+4. **verify** — the chaos invariant suite over everything the campaign
+   left on disk and in memory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# Points armed during the TRAIN leg vs the SERVE leg (the two halves of
+# one campaign; per-leg pacing waits for that leg's cells to fire).
+TRAIN_POINTS = (
+    "checkpoint.write",
+    "checkpoint.pre_rename",
+    "checkpoint.post_rename",
+    "ckpt_writer.submit",
+)
+SERVE_POINTS = (
+    "stream.poll",
+    "gate.eval",
+    "pipeline.poll",
+    "fleet.barrier",
+    "registry.swap",
+    "scheduler.dispatch",
+)
+
+# Hit windows per point: high-frequency seams (polls, worker loops) can
+# absorb faults deep into the campaign; rare seams (one hit per commit
+# or per candidate) need their faults armed early or they never fire.
+WINDOWS = {
+    "checkpoint.write": 3,
+    "checkpoint.pre_rename": 3,
+    "checkpoint.post_rename": 3,
+    "ckpt_writer.submit": 3,
+    "gate.eval": 2,
+    "fleet.barrier": 3,
+    "registry.swap": 2,
+    "stream.poll": 12,
+    "pipeline.poll": 12,
+    "scheduler.dispatch": 12,
+}
+
+
+def build_schedule(
+    seed: int,
+    faults: int,
+    wedge_s: float = 3.0,
+    delay_s: float = 0.02,
+):
+    """The campaign's armed faults — a pure function of the arguments
+    (the determinism the acceptance criterion pins)."""
+    from marl_distributedformation_tpu.chaos import (
+        FaultSchedule,
+        INJECTION_POINTS,
+    )
+
+    points = {
+        p: INJECTION_POINTS[p] for p in TRAIN_POINTS + SERVE_POINTS
+    }
+    return FaultSchedule.from_seed(
+        seed,
+        faults=faults,
+        points=points,
+        windows=WINDOWS,
+        delay_s=delay_s,
+        wedge_s=wedge_s,
+    )
+
+
+def _split(schedule, points: Tuple[str, ...]):
+    from marl_distributedformation_tpu.chaos import FaultSchedule
+
+    wanted = set(points)
+    return FaultSchedule(
+        [s for s in schedule.specs if s.point in wanted],
+        seed=schedule.seed,
+    )
+
+
+class _Prober:
+    """Background request stream through the router: the campaign's
+    recovery witness. Each probe resolves to a success (with the served
+    step) or a typed error; a future that never resolves is exactly the
+    lost-request invariant violation."""
+
+    def __init__(self, router, obs_dim: int, interval_s: float = 0.05):
+        import numpy as np
+
+        self.router = router
+        self.obs = np.zeros((1, obs_dim), np.float32)
+        self.interval_s = interval_s
+        self.outcomes: List[dict] = []
+        self.steps: List[Tuple[float, int]] = []  # (t_done, served step)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _probe_once(self) -> None:
+        from concurrent.futures import TimeoutError as FutureTimeout
+
+        t0 = time.perf_counter()
+        try:
+            future = self.router.submit(self.obs, timeout_s=2.0)
+        except Exception as e:  # noqa: BLE001 — typed reject = resolved
+            self.outcomes.append(
+                {"ok": False, "hung": False, "error": type(e).__name__}
+            )
+            return
+        try:
+            result = future.result(timeout=10.0)
+        except FutureTimeout:
+            self.outcomes.append(
+                {"ok": False, "hung": True, "error": "unresolved future"}
+            )
+            return
+        except Exception as e:  # noqa: BLE001 — typed failure = resolved
+            self.outcomes.append(
+                {"ok": False, "hung": False, "error": type(e).__name__}
+            )
+            return
+        done = time.perf_counter()
+        self.outcomes.append({"ok": True, "hung": False, "error": None})
+        self.steps.append((done, int(result.model_step)))
+        del t0
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._probe_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "_Prober":
+        self._thread = threading.Thread(
+            target=self._loop, name="chaos-prober", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=15.0)
+
+    def mttr_samples(self, disruptions: List[float]) -> List[float]:
+        """For each disruptive-fault time, seconds until the first
+        LATER successful probe."""
+        samples = []
+        for t_fault in disruptions:
+            after = [t for t, _ in self.steps if t > t_fault]
+            if after:
+                samples.append(after[0] - t_fault)
+        return samples
+
+
+def _measure_overhead(router, obs_dim: int, probes: int = 30) -> float:
+    """Cost of the DISABLED fault plane on a served request, measured
+    the only way a nanosecond-scale effect can be: the per-call cost of
+    ``fault_point`` over a large tight loop (minus the same loop's own
+    cost), scaled by the injection points a request crosses, relative
+    to the measured request latency on the warm fleet. An A/B of whole
+    request latencies cannot resolve this — scheduler coalescing noise
+    is 5-6 orders of magnitude larger than one attribute read."""
+    import numpy as np
+
+    from marl_distributedformation_tpu.chaos import (
+        fault_point,
+        get_fault_plane,
+    )
+
+    plane = get_fault_plane()
+    was_enabled = plane.enabled
+    plane.enabled = False
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fault_point("storm.overhead_probe")
+    t_call = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pass
+    t_loop = time.perf_counter() - t0
+    per_call_s = max(0.0, (t_call - t_loop) / n)
+    # One request crosses the frontend handler, the scheduler loop, and
+    # the registry-adjacent seams — call it four points, generously.
+    points_per_request = 4
+    obs = np.zeros((1, obs_dim), np.float32)
+    latencies = []
+    for _ in range(probes):
+        t0 = time.perf_counter()
+        router.submit(obs).result(timeout=10.0)
+        latencies.append(time.perf_counter() - t0)
+    lat = sorted(latencies)[len(latencies) // 2]
+    plane.enabled = was_enabled
+    if lat <= 0.0:
+        return 0.0
+    return 100.0 * points_per_request * per_call_s / lat
+
+
+def run_campaign(
+    seed: int = 0,
+    faults: int = 25,
+    workdir: Optional[str] = None,
+    budget_s: float = 300.0,
+    num_agents: int = 3,
+    num_formations: int = 4,
+    train_iterations: int = 16,
+    eval_formations: int = 8,
+    wedge_s: float = 3.0,
+    gate_timeout_s: float = 1.5,
+    probe_interval_s: float = 0.05,
+) -> Dict[str, Any]:
+    """One full campaign; returns the report dict (the CLI prints it as
+    one JSON line). Import-safe: tests drive this directly."""
+    import tempfile
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.chaos import (
+        DISRUPTIVE_KINDS,
+        LaneWatchdog,
+        check_audit_log,
+        check_budget_one,
+        check_checkpoint_dir,
+        check_no_request_lost,
+        check_step_monotonic,
+        get_fault_plane,
+        report_violations,
+    )
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.pipeline import (
+        AlwaysLearningPipeline,
+        GateConfig,
+    )
+    from marl_distributedformation_tpu.serving.fleet import (
+        fleet_from_checkpoint_dir,
+        warmup_fleet,
+    )
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        checkpoint_step,
+        restore_latest_partial,
+    )
+
+    t_start = time.perf_counter()
+    deadline = t_start + budget_s
+    workdir = Path(
+        workdir if workdir is not None else tempfile.mkdtemp(prefix="chaos_")
+    )
+    log_dir = workdir / "run"
+    env = EnvParams(num_agents=num_agents, max_steps=20)
+    schedule = build_schedule(seed, faults, wedge_s=wedge_s)
+    plane = get_fault_plane()
+    plane.reset()
+    report: Dict[str, Any] = {
+        "deterministic": {
+            "chaos_seed": int(seed),
+            "chaos_faults_armed": len(schedule),
+            "schedule": schedule.record(),
+        },
+    }
+    violations = []
+
+    # ---- phase 1: train under checkpoint-path faults -------------------
+    per_iter = num_formations * num_agents * 5
+    trainer = Trainer(
+        env,
+        ppo=PPOConfig(n_steps=5, n_epochs=2, batch_size=32),
+        config=TrainConfig(
+            num_formations=num_formations,
+            total_timesteps=train_iterations * per_iter,
+            save_freq=5,
+            fused_chunk=2,
+            name="chaos_storm",
+            log_dir=str(log_dir),
+            seed=0,
+        ),
+    )
+    plane.arm(_split(schedule, TRAIN_POINTS))
+    plane.enabled = True
+    trainer.train()  # must SURVIVE the injected write failures
+    plane.enabled = False
+    report["train_writes_skipped"] = None  # filled from registry below
+
+    # ---- phase 2: crash-consistent resume ------------------------------
+    found = restore_latest_partial(log_dir, trainer._checkpoint_target())
+    report["resume_ok"] = bool(found)
+    if found is not None:
+        report["resume_step"] = int(checkpoint_step(found[0]))
+
+    # ---- phase 3: pipeline + fleet under serve-path faults -------------
+    gate_cfg = GateConfig(
+        scenarios=("wind",),
+        severities=(1.0,),
+        eval_formations=eval_formations,
+        clean_tolerance=10.0,
+        rung_tolerance=10.0,
+    )
+    pipeline = AlwaysLearningPipeline(
+        log_dir, env, gate_config=gate_cfg, poll_interval_s=0.05
+    )
+    if not pipeline.wait_first_promotion(timeout_s=max(
+        30.0, deadline - time.perf_counter()
+    )):
+        report["error"] = "no candidate passed the bootstrap gate"
+        report["chaos_invariant_violations"] = -1
+        return report
+    router, coordinator = fleet_from_checkpoint_dir(
+        pipeline.promoted_dir,
+        env_params=env,
+        act_dim=env.act_dim,
+        num_replicas=2,
+        buckets=(1, 8),
+    )
+    prober = None
+    watchdog = LaneWatchdog(
+        wedge_timeout_s=1.0,
+        backoff_base_s=0.1,
+        backoff_cap_s=2.0,
+        poll_interval_s=0.1,
+    )
+    try:
+        router.start()
+        warmup_fleet(router, (env.obs_dim,))
+        pipeline.attach_fleet(router, coordinator)
+        # The disabled-plane overhead, measured on the warm fleet BEFORE
+        # the serve-leg faults arm (both passes fault-free).
+        report["fault_plane_overhead_pct"] = round(
+            _measure_overhead(router, env.obs_dim), 2
+        )
+        # Steady-state gate evals are milliseconds at this scale; the
+        # deadline only needed to outlast the bootstrap compile, which
+        # already happened — now the wedge faults get a real timeout.
+        pipeline.gate.config = dataclasses.replace(
+            gate_cfg, gate_timeout_s=gate_timeout_s
+        )
+        watchdog.watch_pipeline(pipeline)
+        watchdog.watch_fleet(router)
+        watchdog.start()
+        prober = _Prober(
+            router, env.obs_dim, interval_s=probe_interval_s
+        ).start()
+        plane.arm(_split(schedule, SERVE_POINTS))
+        plane.enabled = True
+        pipeline.run(interval_s=0.05)
+        # Pace: run until every serve-leg fault fired or the budget
+        # ends. High-frequency seams (polls, worker loops) absorb their
+        # faults on their own; the CANDIDATE-DRIVEN seams (gate eval,
+        # fleet commit) only hit when a checkpoint flows — and a seed
+        # whose gate faults reject every real candidate would starve
+        # the commit-path cells forever. So while those cells are
+        # pending, the storm keeps the candidate stream fed: byte
+        # copies of the newest valid checkpoint at advancing steps
+        # (exactly what a still-running trainer would provide).
+        import shutil
+
+        from marl_distributedformation_tpu.utils.checkpoint import (
+            checkpoint_path,
+            latest_checkpoint,
+        )
+
+        candidate_points = ("gate.eval", "fleet.barrier", "registry.swap")
+        synth_src = found[0] if found is not None else None
+        newest = latest_checkpoint(log_dir)
+        synth_step = checkpoint_step(newest) if newest is not None else 0
+        synth_last, synth_count = time.perf_counter(), 0
+        while (
+            plane.pending(SERVE_POINTS) > 0
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.1)
+            if (
+                synth_src is not None
+                and plane.pending(candidate_points) > 0
+                and time.perf_counter() - synth_last > 1.5
+                and synth_count < 24
+            ):
+                synth_step += per_iter
+                dst = checkpoint_path(log_dir, synth_step)
+                tmp = dst.with_name(f".{dst.name}.tmp")
+                shutil.copyfile(synth_src, tmp)
+                tmp.replace(dst)
+                pipeline.stream.nudge()
+                synth_last = time.perf_counter()
+                synth_count += 1
+        # Grace so recovery from the LAST fault is observable.
+        time.sleep(max(2.0, wedge_s * 0.75))
+        plane.enabled = False
+        pipeline.stop()
+        watchdog.stop()
+        prober.stop()
+    finally:
+        plane.enabled = False
+        if prober is not None:
+            prober.stop()
+        watchdog.stop()
+        pipeline.stop()
+        router.stop()
+
+    # ---- phase 4: invariants -------------------------------------------
+    fired = plane.fired_record()
+    disruptions = [
+        f["t"]
+        for f in plane.fired
+        if f["kind"] in DISRUPTIVE_KINDS and f["point"] in SERVE_POINTS
+    ]
+    mttr = prober.mttr_samples(disruptions)
+    violations += check_step_monotonic(
+        prober.steps,
+        rollback_to_steps=[r["to_step"] for r in pipeline.rollbacks],
+    )
+    violations += check_no_request_lost(prober.outcomes)
+    compiles = {
+        "gate_matrix": (
+            pipeline.gate.program.compile_count
+            if pipeline.gate.program is not None
+            else 0
+        ),
+    }
+    for replica, per_rung in router.compile_counts().items():
+        for rung, count in per_rung.items():
+            compiles[f"replica{replica}_rung{rung}"] = count
+    violations += check_budget_one(compiles)
+    violations += check_audit_log(log_dir / "promotions.jsonl")
+    violations += check_checkpoint_dir(log_dir)
+    violations += check_checkpoint_dir(pipeline.promoted_dir)
+    from marl_distributedformation_tpu.chaos import Violation
+
+    if disruptions and not mttr:
+        violations.append(
+            Violation(
+                "recovery",
+                f"{len(disruptions)} disruptive fault(s) fired but no "
+                "probe ever succeeded afterwards — the fleet never "
+                "recovered",
+            )
+        )
+    report["chaos_violations"] = report_violations(violations, plane)
+    report["chaos_invariant_violations"] = len(violations)
+    report["chaos_faults_fired"] = len(fired)
+    report["chaos_faults_unfired"] = plane.pending()
+    if mttr:
+        report["chaos_mttr_s"] = round(max(mttr), 3)
+        report["chaos_mttr_p50_s"] = round(sorted(mttr)[len(mttr) // 2], 3)
+    report["chaos_disruptions"] = len(disruptions)
+    report["probes_total"] = len(prober.outcomes)
+    report["probes_ok"] = sum(1 for o in prober.outcomes if o["ok"])
+    report["promotions"] = len(pipeline.promotions)
+    report["rejections"] = len(pipeline.rejections)
+    report["gate_timeouts"] = sum(
+        1 for v in pipeline.rejections if v.timed_out
+    )
+    report["pipeline_restarts"] = watchdog.restarts_total()
+    from marl_distributedformation_tpu.obs import get_registry
+
+    snap = get_registry().snapshot()
+    report["train_writes_skipped"] = int(
+        snap.get("checkpoint_writes_skipped_total", 0)
+    )
+    report["checkpoints_quarantined"] = int(
+        snap.get("checkpoint_quarantined_total", 0)
+    )
+    report["campaign_seconds"] = round(time.perf_counter() - t_start, 2)
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--faults", type=int, default=25)
+    ap.add_argument("--budget-s", type=float, default=300.0)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument(
+        "--print-schedule",
+        action="store_true",
+        help="emit the armed fault schedule (deterministic from the "
+        "seed) and exit without running anything",
+    )
+    args = ap.parse_args(argv)
+    if args.print_schedule:
+        schedule = build_schedule(args.seed, args.faults)
+        print(json.dumps({
+            "chaos_seed": args.seed,
+            "chaos_faults_armed": len(schedule),
+            "schedule": schedule.record(),
+        }))
+        return 0
+    report = run_campaign(
+        seed=args.seed,
+        faults=args.faults,
+        workdir=args.workdir,
+        budget_s=args.budget_s,
+    )
+    print(json.dumps(report))
+    return 0 if report.get("chaos_invariant_violations") == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

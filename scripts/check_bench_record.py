@@ -280,6 +280,61 @@ def _adversarial_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _chaos_problems(rec: dict) -> list[str]:
+    """Structural validation of the chaos-plane fields (bench phase
+    12): whenever present, invariant violations must be exactly 0 (a
+    nonzero count is a broken recovery story, not a slow one), MTTR a
+    finite positive number, and the disabled-plane overhead a finite
+    number under the 5% bar (the plane is one attribute read when
+    disabled — anything near the bar means injection leaked into a hot
+    path). ``"skipped"`` sentinels are honored as structurally
+    absent."""
+    problems = []
+    violations = _present(rec, "chaos_invariant_violations")
+    if violations is not None:
+        try:
+            if int(violations) != 0:
+                problems.append(
+                    f"chaos_invariant_violations={violations!r} — a "
+                    "campaign with ANY invariant violation is a broken "
+                    "recovery path, not evidence"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"chaos_invariant_violations is not an int: {violations!r}"
+            )
+    mttr = _present(rec, "chaos_mttr_s")
+    if mttr is not None:
+        try:
+            v = float(mttr)
+            if not math.isfinite(v) or v <= 0.0:
+                problems.append(
+                    f"chaos_mttr_s={mttr!r} (need a finite number > 0: "
+                    "zero means no disruptive fault was actually "
+                    "recovered from)"
+                )
+        except (TypeError, ValueError):
+            problems.append(f"chaos_mttr_s is not a number: {mttr!r}")
+    overhead = _present(rec, "fault_plane_overhead_pct")
+    if overhead is not None:
+        try:
+            v = float(overhead)
+            if not math.isfinite(v):
+                problems.append(
+                    f"fault_plane_overhead_pct not finite: {overhead!r}"
+                )
+            elif v >= 5.0:
+                problems.append(
+                    f"fault_plane_overhead_pct={v} breaches the 5% bar "
+                    "— the disabled plane must cost one attribute read"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"fault_plane_overhead_pct is not a number: {overhead!r}"
+            )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -297,6 +352,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_telemetry_problems(rec))
     problems.extend(_serving_slo_problems(rec))
     problems.extend(_adversarial_problems(rec))
+    problems.extend(_chaos_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(

@@ -1,0 +1,552 @@
+"""Chaos plane contract (tier-1): deterministic fault injection, the
+crash-consistent checkpoint format, graceful writer degradation,
+self-healing lane supervision, the gate-eval deadline, the invariant
+checkers, and ONE seeded micro-campaign through trainer -> gate ->
+fleet (scripts/chaos_storm.py) with zero invariant violations.
+
+The acceptance pins from the chaos ISSUE:
+
+- a disabled plane is a no-op (and the shipped default);
+- a FaultSchedule is a pure function of its seed (bit-identical
+  replay) and rejects malformed specs;
+- the checksum footer catches bit-flips/truncation, corrupt files are
+  QUARANTINED (renamed aside, audit-logged, invisible to discovery)
+  instead of wedging resume, and legacy footer-less checkpoints stay
+  readable;
+- a crash between tmp-write and rename leaves nothing discoverable;
+- ENOSPC/crash under the AsyncCheckpointWriter degrades to
+  skip-with-audit — never a dead training run;
+- the LaneWatchdog restarts a wedged AND a dead pipeline lane;
+- a wedged candidate yields a ``gate_timeout`` verdict;
+- invariant trips dump ``chaos_violation`` flight records carrying the
+  armed fault schedule.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from marl_distributedformation_tpu.chaos import (
+    FAULT_KINDS,
+    FaultPlane,
+    FaultSchedule,
+    FaultSpec,
+    InjectedFault,
+    LaneWatchdog,
+    SimulatedCrash,
+    Violation,
+    check_audit_log,
+    check_budget_one,
+    check_checkpoint_dir,
+    check_no_request_lost,
+    check_step_monotonic,
+    get_fault_plane,
+    report_violations,
+    set_fault_plane,
+)
+from marl_distributedformation_tpu.utils.checkpoint import (
+    AsyncCheckpointWriter,
+    CorruptCheckpointError,
+    _write_atomic,
+    checkpoint_path,
+    latest_checkpoint,
+    msgpack_restore_file,
+    restore_checkpoint,
+    restore_latest_partial,
+)
+
+
+@pytest.fixture
+def plane():
+    """A test-private FaultPlane installed as the process-global one;
+    the shipped default (disabled) is restored afterwards."""
+    fresh = FaultPlane(enabled=True)
+    previous = set_fault_plane(fresh)
+    yield fresh
+    set_fault_plane(previous)
+
+
+@pytest.fixture
+def private_registry():
+    from marl_distributedformation_tpu.obs import (
+        MetricsRegistry,
+        set_registry,
+    )
+
+    registry = MetricsRegistry()
+    previous = set_registry(registry)
+    yield registry
+    set_registry(previous)
+
+
+@pytest.fixture
+def private_tracer(tmp_path):
+    from marl_distributedformation_tpu.obs import (
+        FlightRecorder,
+        Tracer,
+        set_tracer,
+    )
+
+    tracer = Tracer(
+        ring_size=1024,
+        flightrec=FlightRecorder(tmp_path / "flightrec", last_n=128),
+    )
+    previous = set_tracer(tracer)
+    yield tracer
+    set_tracer(previous)
+
+
+def _target():
+    return {
+        "params": np.arange(64, dtype=np.float32).reshape(8, 8),
+        "num_timesteps": 40,
+    }
+
+
+# ---------------------------------------------------------------------------
+# FaultPlane / FaultSchedule
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_plane_is_a_noop():
+    plane = FaultPlane(enabled=False)
+    plane.arm(FaultSchedule([FaultSpec("stream.poll", "raise", 1)]))
+    for _ in range(5):
+        plane.hit("stream.poll")  # armed but disabled: nothing fires
+    assert plane.fired == []
+    assert plane.pending() == 1
+    # The shipped process-global default is disabled.
+    assert get_fault_plane().enabled is False
+
+
+def test_schedule_deterministic_from_seed_and_kind_coverage():
+    a = FaultSchedule.from_seed(42, faults=25)
+    b = FaultSchedule.from_seed(42, faults=25)
+    assert json.dumps(a.record()) == json.dumps(b.record())
+    assert len(a) == 25
+    # The coverage pass guarantees every kind appears.
+    assert {s.kind for s in a.specs} == set(FAULT_KINDS)
+    # A different seed is a different schedule.
+    c = FaultSchedule.from_seed(43, faults=25)
+    assert json.dumps(a.record()) != json.dumps(c.record())
+
+
+def test_schedule_validation():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSchedule([FaultSpec("stream.poll", "meteor", 1)])
+    with pytest.raises(ValueError, match="cannot express"):
+        # checkpoint.write is IO-shaped: generic raise not armable.
+        FaultSchedule([FaultSpec("checkpoint.write", "raise", 1)])
+    with pytest.raises(ValueError, match="duplicate fault cell"):
+        FaultSchedule([
+            FaultSpec("stream.poll", "raise", 1),
+            FaultSpec("stream.poll", "delay", 1),
+        ])
+
+
+def test_fault_fires_at_exact_hit(plane):
+    plane.arm(FaultSchedule([FaultSpec("stream.poll", "raise", 3)]))
+    plane.hit("stream.poll")
+    plane.hit("stream.poll")
+    with pytest.raises(InjectedFault):
+        plane.hit("stream.poll")
+    plane.hit("stream.poll")  # one-shot: consumed
+    assert [f["at_hit"] for f in plane.fired_record()] == [3]
+
+
+# ---------------------------------------------------------------------------
+# Crash-consistent checkpoint format (hardening a)
+# ---------------------------------------------------------------------------
+
+
+def test_footer_roundtrip_and_legacy_files_readable(tmp_path):
+    from flax import serialization
+
+    path = checkpoint_path(tmp_path, 40)
+    _write_atomic(path, _target())
+    restored = restore_checkpoint(path, _target())
+    np.testing.assert_array_equal(restored["params"], _target()["params"])
+    # A legacy (footer-less) file written before the chaos plane still
+    # reads — the format is backward-compatible.
+    legacy = checkpoint_path(tmp_path / "legacy", 40)
+    legacy.parent.mkdir()
+    legacy.write_bytes(serialization.to_bytes(_target()))
+    restored = restore_checkpoint(legacy, _target())
+    assert int(restored["num_timesteps"]) == 40
+
+
+def test_bitflip_is_quarantined_not_served(
+    tmp_path, private_registry, private_tracer
+):
+    from marl_distributedformation_tpu.chaos.plane import _corrupt_file
+
+    path = checkpoint_path(tmp_path, 40)
+    _write_atomic(path, _target())
+    _corrupt_file(str(path), "bitflip")
+    with pytest.raises(CorruptCheckpointError):
+        msgpack_restore_file(path)
+    # Quarantined: renamed aside, invisible to discovery, audit-logged.
+    assert not path.exists()
+    assert path.with_name(path.name + ".quarantined").exists()
+    assert latest_checkpoint(tmp_path) is None
+    audit = json.loads(
+        (tmp_path / "quarantine.jsonl").read_text().splitlines()[0]
+    )
+    assert audit["file"] == path.name and "checksum" in audit["reason"]
+    assert (
+        private_registry.snapshot()["checkpoint_quarantined_total"] == 1.0
+    )
+    # The directory now passes the crash-consistency invariant.
+    assert check_checkpoint_dir(tmp_path) == []
+
+
+def test_truncation_walkback_resumes_from_newest_valid(
+    tmp_path, private_registry, private_tracer
+):
+    """A truncated NEWEST checkpoint costs one checkpoint of progress,
+    never a wedged resume: restore_latest_partial quarantines it and
+    walks back to the older valid file."""
+    good = checkpoint_path(tmp_path, 40)
+    _write_atomic(good, _target())
+    bad = checkpoint_path(tmp_path, 80)
+    _write_atomic(bad, {**_target(), "num_timesteps": 80})
+    with open(bad, "r+b") as f:
+        f.truncate(bad.stat().st_size // 2)
+    found = restore_latest_partial(tmp_path, _target())
+    assert found is not None
+    path, restored = found
+    assert path == good
+    assert int(restored["num_timesteps"]) == 40
+    assert not bad.exists()
+    assert check_checkpoint_dir(tmp_path) == []
+
+
+def test_crash_mid_rename_leaves_nothing_discoverable(plane, tmp_path):
+    plane.arm(
+        FaultSchedule([FaultSpec("checkpoint.pre_rename", "crash", 1)])
+    )
+    path = checkpoint_path(tmp_path, 40)
+    with pytest.raises(SimulatedCrash):
+        _write_atomic(path, _target())
+    # The torn write is a dot-prefixed tmp only: invisible to discovery,
+    # clean under the crash-consistency invariant.
+    assert not path.exists()
+    assert (tmp_path / f".{path.name}.tmp").exists()
+    assert latest_checkpoint(tmp_path) is None
+    assert check_checkpoint_dir(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointWriter degradation (hardening b)
+# ---------------------------------------------------------------------------
+
+
+def test_writer_transient_enospc_retries_and_lands(plane, tmp_path):
+    plane.arm(
+        FaultSchedule([FaultSpec("checkpoint.write", "enospc", 1)])
+    )
+    writer = AsyncCheckpointWriter(io_retries=3, io_backoff_s=0.001)
+    path = writer.submit(checkpoint_path(tmp_path, 40), _target())
+    writer.close()  # would raise on a surfaced failure
+    assert path.exists()  # the retry landed the write
+    assert writer.writes_skipped == 0
+    restored = restore_checkpoint(path, _target())
+    assert int(restored["num_timesteps"]) == 40
+
+
+def test_writer_persistent_enospc_skips_with_audit(
+    plane, tmp_path, private_registry, private_tracer
+):
+    plane.arm(
+        FaultSchedule([
+            FaultSpec("checkpoint.write", "enospc", h) for h in (1, 2, 3)
+        ])
+    )
+    writer = AsyncCheckpointWriter(io_retries=2, io_backoff_s=0.001)
+    path = writer.submit(checkpoint_path(tmp_path, 40), _target())
+    writer.wait()  # must NOT raise: degraded, not dead
+    assert not path.exists()
+    assert writer.writes_skipped == 1
+    snap = private_registry.snapshot()
+    assert snap["checkpoint_writes_skipped_total"] == 1.0
+    dumps = [
+        p.name for p in private_tracer.flightrec.dumps()
+    ]
+    assert any("checkpoint_write_skipped" in n for n in dumps)
+    # The writer is still healthy: the NEXT write succeeds.
+    path2 = writer.submit(checkpoint_path(tmp_path, 80), _target())
+    writer.close()
+    assert path2.exists()
+
+
+def test_writer_injected_crash_skips_with_audit(
+    plane, tmp_path, private_registry, private_tracer
+):
+    plane.arm(
+        FaultSchedule([FaultSpec("checkpoint.pre_rename", "crash", 1)])
+    )
+    writer = AsyncCheckpointWriter(io_retries=2, io_backoff_s=0.001)
+    path = writer.submit(checkpoint_path(tmp_path, 40), _target())
+    writer.close()  # a crashed write is SKIPPED, never surfaced
+    assert not path.exists()
+    assert writer.writes_skipped == 1
+    assert latest_checkpoint(tmp_path) is None  # tmp stays invisible
+    # Non-IO failures still surface — program errors are not weather.
+    writer2 = AsyncCheckpointWriter()
+    writer2.submit_write(lambda: (_ for _ in ()).throw(TypeError("bug")))
+    with pytest.raises(RuntimeError, match="async checkpoint write"):
+        writer2.close()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog (hardening c)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_restarts_wedged_then_dead_pipeline_lane(
+    plane, tmp_path, private_registry, private_tracer
+):
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.pipeline import (
+        AlwaysLearningPipeline,
+    )
+
+    pipeline = AlwaysLearningPipeline(
+        tmp_path, EnvParams(num_agents=3, max_steps=20),
+        poll_interval_s=0.01,
+    )
+    plane.arm(
+        FaultSchedule([
+            FaultSpec("pipeline.poll", "wedge", 2, seconds=1.5),
+            FaultSpec("pipeline.poll", "crash", 30),
+        ])
+    )
+    watchdog = LaneWatchdog(
+        wedge_timeout_s=0.3, backoff_base_s=0.02, poll_interval_s=0.03
+    )
+    watchdog.watch_pipeline(pipeline)
+    watchdog.start()
+    pipeline.run(interval_s=0.01)
+    deadline = time.monotonic() + 20.0
+    while watchdog.restarts_total() < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    plane.enabled = False
+    try:
+        assert watchdog.restarts_total() >= 2, watchdog.restart_log
+        reasons = [e["reason"] for e in watchdog.restart_log]
+        assert any("stale" in r for r in reasons)  # the wedge
+        assert any("dead" in r for r in reasons)  # the crash
+        # The lane is ALIVE again after both injuries.
+        assert pipeline.loop_alive()
+        snap = private_registry.snapshot()
+        assert snap["pipeline_restarts_total"] >= 2.0
+        # Every self-heal left a postmortem flight record.
+        assert any(
+            "lane_restart" in p.name
+            for p in private_tracer.flightrec.dumps()
+        )
+    finally:
+        watchdog.stop()
+        pipeline.stop()
+
+
+# ---------------------------------------------------------------------------
+# Gate-eval deadline (hardening d)
+# ---------------------------------------------------------------------------
+
+
+def test_gate_timeout_verdict(plane, tmp_path, private_registry):
+    import dataclasses
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.pipeline import (
+        GateConfig,
+        PromotionGate,
+    )
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        checkpoint_step,
+    )
+
+    env = EnvParams(num_agents=3, max_steps=20)
+    trainer = Trainer(
+        env,
+        ppo=PPOConfig(n_steps=5, n_epochs=2, batch_size=32),
+        config=TrainConfig(
+            num_formations=4, total_timesteps=2 * 4 * 3 * 5,
+            save_freq=5, name="chaos_gate", log_dir=str(tmp_path),
+        ),
+    )
+    trainer.train()
+    ckpt = latest_checkpoint(tmp_path)
+    assert ckpt is not None
+    cfg = GateConfig(
+        scenarios=("wind",), severities=(1.0,), eval_formations=4,
+        clean_tolerance=10.0, rung_tolerance=10.0,
+    )
+    gate = PromotionGate(env, cfg)
+    plane.enabled = False
+    warm = gate.evaluate(ckpt)  # compile outside the deadline
+    assert warm.passed and not warm.timed_out
+    gate.config = dataclasses.replace(cfg, gate_timeout_s=0.3)
+    plane.enabled = True
+    plane.arm(
+        FaultSchedule([FaultSpec("gate.eval", "wedge", 1, seconds=1.5)])
+    )
+    verdict = gate.evaluate(ckpt)
+    assert not verdict.passed and verdict.timed_out
+    assert verdict.reasons[0].startswith("gate_timeout:")
+    assert verdict.record()["gate_timeout"] is True
+    assert verdict.step == checkpoint_step(ckpt)
+    snap = private_registry.snapshot()
+    assert snap["pipeline_gate_timeouts_total"] == 1.0
+    # The stream moves on: the next candidate evaluates normally (the
+    # abandoned wedged thread finishes harmlessly in the background,
+    # and the compiled program stayed budget-1).
+    time.sleep(1.6)
+    ok = gate.evaluate(ckpt)
+    assert ok.passed and not ok.timed_out
+    assert gate.program.compile_count == 1
+
+
+# ---------------------------------------------------------------------------
+# Invariant checkers + the chaos_violation alarm
+# ---------------------------------------------------------------------------
+
+
+def test_invariant_checkers_unit(tmp_path):
+    # Step monotonicity: backward is a violation unless an audited
+    # rollback explains the exact step landed on.
+    assert check_step_monotonic([(0, 10), (1, 20), (2, 20)]) == []
+    trips = check_step_monotonic([(0, 10), (1, 20), (2, 10)])
+    assert len(trips) == 1 and trips[0].invariant == "step_monotonic"
+    assert check_step_monotonic(
+        [(0, 10), (1, 20), (2, 10)], rollback_to_steps=[10]
+    ) == []
+    # Lost requests: only HUNG futures trip (typed errors resolved).
+    assert check_no_request_lost(
+        [{"ok": True, "hung": False}, {"ok": False, "hung": False}]
+    ) == []
+    assert check_no_request_lost([{"ok": False, "hung": True}])
+    # Budget-1 receipts.
+    assert check_budget_one({"gate": 1, "rung8": 0}) == []
+    assert check_budget_one({"gate": 2})[0].invariant == "budget_one"
+    # Audit log: ascending promotions, rollback to a promoted step.
+    log = tmp_path / "promotions.jsonl"
+    lines = [
+        {"schema": 3, "event": "promoted", "time": 1.0, "step": 10},
+        {"schema": 3, "event": "rejected", "time": 2.0, "step": 15},
+        {"schema": 3, "event": "promoted", "time": 3.0, "step": 20},
+        {"schema": 3, "event": "rolled_back", "time": 4.0,
+         "from_step": 20, "to_step": 10},
+    ]
+    log.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    assert check_audit_log(log) == []
+    lines.append({"schema": 3, "event": "promoted", "time": 5.0, "step": 5})
+    lines.append({"schema": 3, "event": "rolled_back", "time": 6.0,
+                  "from_step": 5, "to_step": 7})
+    log.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    trips = check_audit_log(log)
+    assert {t.invariant for t in trips} == {"audit_log"}
+    assert len(trips) == 2  # non-ascending promote + rollback to ghost
+    # Checkpoint dir: a corrupt DISCOVERABLE file trips; a quarantined
+    # one does not (covered in the quarantine tests above).
+    d = tmp_path / "ckpts"
+    d.mkdir()
+    _write_atomic(checkpoint_path(d, 40), _target())
+    assert check_checkpoint_dir(d) == []
+    bad = checkpoint_path(d, 80)
+    _write_atomic(bad, _target())
+    with open(bad, "r+b") as f:
+        f.seek(10)
+        f.write(b"\x00\x01\x02")
+    trips = check_checkpoint_dir(d)
+    assert len(trips) == 1
+    assert trips[0].invariant == "checkpoint_crash_consistency"
+
+
+def test_chaos_violation_dumps_flight_record_with_schedule(
+    plane, private_tracer, private_registry
+):
+    plane.arm(FaultSchedule([FaultSpec("stream.poll", "raise", 9)]))
+    records = report_violations(
+        [Violation("step_monotonic", "went backward 20 -> 10")],
+        plane,
+    )
+    assert len(records) == 1
+    dumps = [
+        p
+        for p in private_tracer.flightrec.dumps()
+        if "chaos_violation" in p.name
+    ]
+    assert len(dumps) == 1
+    payload = json.loads(dumps[0].read_text())
+    ctx = payload["context"]
+    assert ctx["invariant"] == "step_monotonic"
+    # The armed fault schedule rides the dump as STRUCTURED context —
+    # the campaign is diagnosable from its artifacts alone.
+    assert ctx["fault_schedule_armed"] == [
+        {"point": "stream.poll", "kind": "raise", "at_hit": 9,
+         "seconds": 0.0}
+    ]
+    snap = private_registry.snapshot()
+    assert snap["chaos_invariant_violations_total"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# The storm: one seeded micro-campaign, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_storm_campaign_zero_violations(tmp_path):
+    """ONE full campaign at tiny scale: >= 25 faults spanning every
+    kind through trainer -> gate -> fleet, zero invariant violations,
+    finite MTTR, ~0 disabled-plane overhead — and the deterministic
+    report section equals the pure-function schedule for the seed
+    (what ``--print-schedule`` emits), pinning bit-identical replay."""
+    import pathlib
+    import sys
+
+    scripts = pathlib.Path(__file__).resolve().parent.parent / "scripts"
+    sys.path.insert(0, str(scripts))
+    try:
+        from chaos_storm import build_schedule, run_campaign
+    finally:
+        sys.path.pop(0)
+
+    plane = get_fault_plane()
+    try:
+        report = run_campaign(
+            seed=7,
+            faults=25,
+            workdir=str(tmp_path),
+            budget_s=150.0,
+            wedge_s=1.2,
+            gate_timeout_s=0.6,
+        )
+    finally:
+        plane.enabled = False
+        plane.reset()
+    assert report["chaos_invariant_violations"] == 0, report.get(
+        "chaos_violations"
+    )
+    assert report["chaos_faults_fired"] == 25
+    assert report["chaos_faults_unfired"] == 0
+    assert report["resume_ok"]
+    assert 0.0 < report["chaos_mttr_s"] < 60.0
+    assert report["fault_plane_overhead_pct"] < 5.0
+    assert report["probes_ok"] > 0
+    # Replay determinism: the report's deterministic section is exactly
+    # the seed's pure-function schedule.
+    expected = build_schedule(7, 25, wedge_s=1.2)
+    assert report["deterministic"] == {
+        "chaos_seed": 7,
+        "chaos_faults_armed": 25,
+        "schedule": expected.record(),
+    }
+    kinds = {f["kind"] for f in expected.record()}
+    assert {"crash", "wedge", "enospc", "delay"} <= kinds
+    assert kinds & {"truncate", "bitflip"}  # corrupt coverage

@@ -313,6 +313,35 @@ def test_check_bench_record_gates():
         },
         [], [],
     ) == []
+    # Chaos-plane fields (bench phase 12), validated whenever present:
+    # violations must be exactly 0, MTTR finite and > 0, the
+    # disabled-plane overhead finite and under the 5% bar (negative is
+    # legitimate — noise around zero), "skipped" sentinels honored.
+    chaos_ok = {
+        **clean,
+        "chaos_invariant_violations": 0,
+        "chaos_mttr_s": 0.8,
+        "fault_plane_overhead_pct": -0.2,
+    }
+    assert check(chaos_ok, [], []) == []
+    assert check({**chaos_ok, "chaos_invariant_violations": 1}, [], [])
+    assert check({**chaos_ok, "chaos_invariant_violations": "none"}, [], [])
+    assert check({**chaos_ok, "chaos_mttr_s": 0.0}, [], [])
+    assert check({**chaos_ok, "chaos_mttr_s": float("inf")}, [], [])
+    assert check({**chaos_ok, "chaos_mttr_s": "fast"}, [], [])
+    assert check({**chaos_ok, "fault_plane_overhead_pct": 7.5}, [], [])
+    assert check(
+        {**chaos_ok, "fault_plane_overhead_pct": float("nan")}, [], []
+    )
+    assert check(
+        {
+            **clean,
+            "chaos_invariant_violations": "skipped",
+            "chaos_mttr_s": "skipped",
+            "fault_plane_overhead_pct": "skipped",
+        },
+        [], [],
+    ) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

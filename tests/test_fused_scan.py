@@ -244,11 +244,14 @@ def test_async_save_matches_sync_save_bytes(tmp_path):
 def test_async_writer_crash_mid_write_leaves_nothing_visible(
     tmp_path, monkeypatch
 ):
-    """A crash between the tmp write and the atomic rename (the worst
-    possible moment) surfaces as an error AND leaves no discoverable
+    """A persistent IO failure between the tmp write and the atomic
+    rename (the worst possible moment) leaves no discoverable
     checkpoint — the dot-prefixed .tmp is invisible to
     latest_checkpoint (the _write_atomic invariant, now load-bearing
-    from a background thread)."""
+    from a background thread) — and, since the chaos hardening
+    (docs/chaos.md), is retried then SKIPPED with audit instead of
+    killing the training run: close() does not raise, the skip is
+    counted, and the next write lands normally."""
     real_replace = pathlib.Path.replace
 
     def exploding_replace(self, target):
@@ -257,13 +260,13 @@ def test_async_writer_crash_mid_write_leaves_nothing_visible(
         return real_replace(self, target)
 
     monkeypatch.setattr(pathlib.Path, "replace", exploding_replace)
-    writer = AsyncCheckpointWriter()
+    writer = AsyncCheckpointWriter(io_retries=1, io_backoff_s=0.001)
     writer.submit(
         checkpoint_path(tmp_path, 5),
         {"params": np.zeros(3, np.float32), "num_timesteps": 5},
     )
-    with pytest.raises(RuntimeError, match="async checkpoint"):
-        writer.close()
+    writer.close()  # degraded, not dead: no surfaced error
+    assert writer.writes_skipped == 1
     assert latest_checkpoint(tmp_path) is None, (
         "a torn async write must never be discoverable"
     )
@@ -278,10 +281,13 @@ def test_async_writer_crash_mid_write_leaves_nothing_visible(
 
 
 def test_async_writer_error_surfaces_on_next_submit(tmp_path, monkeypatch):
+    """PROGRAM errors (a serialization bug, a bad snapshot tree) still
+    surface on the next submit — only IO weather degrades to
+    skip-with-audit (tests/test_chaos.py pins that side)."""
     from marl_distributedformation_tpu.utils import checkpoint as ckpt_mod
 
     def boom(path, target):
-        raise OSError("no space left on device")
+        raise TypeError("unserializable leaf in snapshot tree")
 
     monkeypatch.setattr(ckpt_mod, "_write_atomic", boom)
     writer = AsyncCheckpointWriter()

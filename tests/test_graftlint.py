@@ -983,6 +983,70 @@ FIXTURES = [
             return carry, stacked
         """,
     ),
+    (
+        # Rule 19: a chaos injection point under trace — the armed
+        # fault fires once at COMPILE time (or unwinds the tracer
+        # itself) while the campaign believes it exercises every step.
+        # The good twin injects at the dispatch seam around the call.
+        "fault-point-in-traced-scope",
+        """
+        import jax
+        from marl_distributedformation_tpu.chaos import fault_point
+
+        @jax.jit
+        def step(x):
+            fault_point("trainer.step")
+            return x * 2
+        """,
+        """
+        import jax
+        from marl_distributedformation_tpu.chaos import fault_point
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def dispatch(x):
+            fault_point("trainer.dispatch")
+            return step(x)
+        """,
+    ),
+    (
+        # Same hazard one hop away inside a scan body, through the
+        # plane-receiver chain: the helper's hit() would count per
+        # trace, not per iteration. The good twin's helper is only
+        # called from the host-side drain, and an unrelated .hit()
+        # receiver stays clean.
+        "fault-point-in-traced-scope",
+        """
+        from jax import lax
+        from marl_distributedformation_tpu.chaos import get_fault_plane
+
+        def poke():
+            get_fault_plane().hit("sweep.member")
+
+        def train(xs):
+            def body(carry, x):
+                poke()
+                return carry + x, x
+            return lax.scan(body, 0.0, xs)
+        """,
+        """
+        from jax import lax
+        from marl_distributedformation_tpu.chaos import get_fault_plane
+
+        def poke():
+            get_fault_plane().hit("sweep.drain")
+
+        def train(xs, target):
+            def body(carry, x):
+                target.hit(x)  # not plane-like: stays clean
+                return carry + x, x
+            carry, stacked = lax.scan(body, 0.0, xs)
+            poke()  # the drain seam: host-side
+            return carry, stacked
+        """,
+    ),
 ]
 
 

@@ -381,7 +381,11 @@ def _train_checkpoints(log_dir, iterations=3, seed=0):
 def _sabotage_nan(path):
     """Corrupt a checkpoint's params with NaN, keeping the architecture
     (it must LOAD fine and fail the gate on eval, not on restore)."""
-    raw = serialization.msgpack_restore(path.read_bytes())
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        msgpack_restore_file,
+    )
+
+    raw = msgpack_restore_file(path)
     raw["params"] = jax.tree_util.tree_map(
         lambda x: np.full_like(x, np.nan)
         if isinstance(x, np.ndarray) and np.issubdtype(x.dtype, np.floating)

@@ -511,6 +511,51 @@ def test_backoff_is_capped_exponential_with_retry_after_floor():
     assert backoff_s(10, retry_after_s=0.01, base_s=0.05, cap_s=2.0) == 2.0
 
 
+def test_backoff_full_jitter_spreads_the_stampede():
+    """A fleet of clients hitting the same 429 must NOT wake in
+    lockstep: with jitter, the sleep is a uniform random fraction of
+    the capped-exponential delay — spread over the window, still
+    floored at the server's retry_after, still bounded by the cap.
+    Distribution pinned with a seeded RNG."""
+    import random
+
+    from marl_distributedformation_tpu.serving import backoff_s
+
+    rng = random.Random(1234)
+    cap = 2.0
+    samples = [
+        backoff_s(
+            10, retry_after_s=0.01, base_s=0.05, cap_s=cap,
+            jitter=rng.random,
+        )
+        for _ in range(500)
+    ]
+    # Floor and cap both hold for every draw.
+    assert all(0.01 <= s <= cap for s in samples)
+    # Full jitter means SPREAD, not a point mass at the cap (the
+    # un-jittered value): many distinct values across the window, with
+    # mass in the low, middle, and high thirds.
+    assert len(set(samples)) > 400
+    assert min(samples) < 0.2 and max(samples) > 1.8
+    mean = sum(samples) / len(samples)
+    assert 0.8 < mean < 1.2  # E[U(0,1)] * cap == cap/2, within noise
+    # The floor still wins when the server prices a LONGER wait than
+    # any jittered exponential draw.
+    assert backoff_s(
+        0, retry_after_s=3.0, base_s=0.05, cap_s=2.0, jitter=rng.random
+    ) == 3.0
+    # The client wires its own RNG through: jitter=False keeps the
+    # deterministic ladder for single-caller tools.
+    from marl_distributedformation_tpu.serving import ServingClient
+
+    client = ServingClient(
+        object(), jitter=True, rng=random.Random(7)
+    )
+    assert client.jitter and client._rng.random() == random.Random(
+        7
+    ).random()
+
+
 def test_client_retries_through_backpressure_and_succeeds():
     """Opt-in retries absorb transient rejects: a client facing a full
     queue sleeps the (floored, capped-exponential) backoff and lands the
