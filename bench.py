@@ -66,6 +66,14 @@ Measures, inside one process and one JSON line:
   whole trainer -> gate -> fleet loop; MTTR is worst kill -> first
   served recovery, violations MUST be 0, and the disabled plane's
   per-request cost is ~0 (one attribute read per injection point).
+- ``ledger_overhead_pct`` / ``ledger_program_count`` /
+  ``ledger_compile_seconds_total``: the program ledger (obs/ledger.py)
+  — the fused loop re-timed with per-dispatch ledger recording on vs
+  off (interleaved, same methodology as phases 8/11), plus the census
+  headlines off the whole bench run's process-global ledger: how many
+  compiled executables registered and their attributed backend-compile
+  wall. The census itself is what a chip window commits beside this
+  record (``check_bench_record.py --census``).
 
 Phases skipped via
   ``BENCH_SKIP_*`` env vars record the explicit ``"skipped"`` sentinel
@@ -97,7 +105,8 @@ BENCH_PIPELINE_GATE_M, BENCH_PIPELINE_BUDGET_S, BENCH_SLO_DURATION_S,
 BENCH_SLO_P95_MS, BENCH_SKIP_ADVERSARIAL=1, BENCH_ADV_M,
 BENCH_ADV_ITERS, BENCH_ADV_EVAL_M, BENCH_TELEMETRY_CHUNK,
 BENCH_TELEMETRY_PASSES, BENCH_SENTINEL_CHECKS, BENCH_SKIP_CHAOS=1,
-BENCH_CHAOS_SEED, BENCH_CHAOS_FAULTS.
+BENCH_CHAOS_SEED, BENCH_CHAOS_FAULTS, BENCH_LEDGER_CHUNK,
+BENCH_LEDGER_PASSES (the ledger phase shares BENCH_SKIP_TRAIN).
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -1841,6 +1850,164 @@ def main() -> None:
                 notes.append(f"chaos phase failed: {e!r}"[:200])
         else:
             notes.append("chaos phase skipped: deadline")
+
+        # --- Phase 13: program ledger (obs/ledger.py,
+        # docs/observability.md "Program ledger"): the phase-11 fused
+        # training loop re-timed with the ledger enabled vs disabled,
+        # interleaved best-of-N passes (the phase-8/11 rationale:
+        # back-to-back per-mode timing on a shared container books
+        # load drift to whichever mode hit the bad window). The bar is
+        # < 5%: steady-state ledger cost is a perf_counter pair plus a
+        # per-thread shard append per dispatch; registration happens
+        # once per COMPILE. Beside it, the census headline fields off
+        # the process-global ledger, which by this point has seen every
+        # program this bench run compiled: ledger_program_count and
+        # ledger_compile_seconds_total (attributed backend-compile
+        # wall, the number the chip window commits and the census diff
+        # gate re-checks).
+        ledger_fields = (
+            "ledger_overhead_pct",
+            "ledger_program_count",
+            "ledger_compile_seconds_total",
+        )
+        if os.environ.get("BENCH_SKIP_TRAIN") == "1":
+            _mark_skipped(result, "ledger", ledger_fields)
+        elif time.time() < deadline - 30:
+            try:
+                from marl_distributedformation_tpu.algo import PPOConfig
+                from marl_distributedformation_tpu.obs import (
+                    configure_ledger,
+                    get_ledger,
+                )
+                from marl_distributedformation_tpu.train import (
+                    TrainConfig,
+                    Trainer,
+                )
+                from marl_distributedformation_tpu.utils import (
+                    MetricsLogger,
+                )
+                from marl_distributedformation_tpu.utils.config import (
+                    PRESETS,
+                )
+                from marl_distributedformation_tpu.utils.profiling import (
+                    Throughput,
+                )
+
+                l_chunk = _env_int("BENCH_LEDGER_CHUNK", 8)
+                train_m = _env_int("BENCH_TRAIN_M", M if on_accel else 256)
+                configure_ledger(enabled=True)  # registration pass
+                trainer = Trainer(
+                    EnvParams(num_agents=N),
+                    ppo=PPOConfig(
+                        batch_size=PRESETS["tpu"]["batch_size"]
+                    ),
+                    config=TrainConfig(
+                        num_formations=train_m, checkpoint=False,
+                        use_wandb=False, name="bench_ledger",
+                        log_dir="/tmp/bench_ledger",
+                        fused_chunk=l_chunk,
+                    ),
+                )
+                for _ in range(2):  # warm twice (_time_fused_phase)
+                    stacked = trainer.run_chunk()
+                    float(stacked["loss"][-1])
+                    if time.time() > deadline:
+                        break
+                logger = MetricsLogger(
+                    "/tmp/bench_ledger", run_name="bench_ledger"
+                )
+                meter = Throughput()
+
+                def ledger_pass() -> float:
+                    # The double-buffered Anakin loop, same shape as
+                    # phase 11: dispatch N+1, drain N through the real
+                    # instrumented seam. The on/off delta is exactly
+                    # the ledger's dispatch-recording cost.
+                    dispatches, iteration, pending = 0, 0, None
+                    t0 = time.perf_counter()
+                    while True:
+                        steps_before = trainer.num_timesteps
+                        stacked = trainer.run_chunk()
+                        dispatches += 1
+                        if pending is not None:
+                            trainer._drain_chunk(logger, meter, *pending)
+                        pending = (stacked, iteration, steps_before, None)
+                        iteration += l_chunk
+                        if (
+                            time.perf_counter() - t0 >= MIN_TIMED_S / 2
+                            or time.time() > deadline
+                            or dispatches * l_chunk >= 128
+                        ):
+                            break
+                    trainer._drain_chunk(logger, meter, *pending)
+                    elapsed = time.perf_counter() - t0
+                    n_steps = trainer.ppo.n_steps
+                    return (
+                        n_steps * train_m * dispatches * l_chunk / elapsed
+                    )
+
+                passes = _env_int("BENCH_LEDGER_PASSES", 2)
+                rates = {"on": 0.0, "off": 0.0}
+                expired = False
+                for _ in range(max(1, passes)):
+                    for mode in ("on", "off"):
+                        configure_ledger(enabled=(mode == "on"))
+                        rates[mode] = max(rates[mode], ledger_pass())
+                        if time.time() > deadline:
+                            expired = True
+                            break
+                    if expired:
+                        break
+                configure_ledger(enabled=True)
+                logger.close()
+                if rates["on"] > 0.0 and rates["off"] > 0.0:
+                    overhead = (
+                        100.0 * (rates["off"] - rates["on"]) / rates["off"]
+                    )
+                    result["ledger_overhead_pct"] = round(overhead, 2)
+                    result["ledger_fused_rate_on"] = round(rates["on"], 1)
+                    result["ledger_fused_rate_off"] = round(
+                        rates["off"], 1
+                    )
+                else:
+                    notes.append(
+                        "ledger overhead unmeasured: deadline before "
+                        "both modes ran"
+                    )
+                # Census headlines off the whole bench run's ledger.
+                ledger = get_ledger()
+                census = ledger.census()
+                result["ledger_program_count"] = census["totals"][
+                    "programs"
+                ]
+                result["ledger_compile_seconds_total"] = round(
+                    census["totals"]["compile_seconds"], 3
+                )
+                result["ledger_compile_seconds_max"] = round(
+                    ledger.compile_seconds_max(), 3
+                )
+                by_source = {}
+                for prog in census["programs"]:
+                    src = prog.get("analysis_source", "unavailable")
+                    by_source[src] = by_source.get(src, 0) + 1
+                result["ledger_analysis_sources"] = by_source
+                wm = census["totals"].get("watermark_bytes")
+                if wm is not None:
+                    result["device_memory_watermark_bytes"] = wm
+                print(
+                    "[bench] ledger (fused-scan loop, chunk="
+                    f"{l_chunk}): {rates['on']:,.0f} formation-steps/s "
+                    f"recorded vs {rates['off']:,.0f} unrecorded "
+                    f"({result.get('ledger_overhead_pct', 'n/a')}%); "
+                    f"census {result['ledger_program_count']} programs, "
+                    f"{result['ledger_compile_seconds_total']:.1f}s "
+                    "compile",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                notes.append(f"ledger phase failed: {e!r}"[:200])
+        else:
+            notes.append("ledger phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

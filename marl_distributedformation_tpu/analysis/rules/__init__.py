@@ -29,6 +29,9 @@ from marl_distributedformation_tpu.analysis.rules.fault_scope import (
     FaultPointInTracedScope,
 )
 from marl_distributedformation_tpu.analysis.rules.host_sync import HostSyncInJit
+from marl_distributedformation_tpu.analysis.rules.ledger_scope import (
+    LedgerRecordInTracedScope,
+)
 from marl_distributedformation_tpu.analysis.rules.metrics_scope import (
     MetricsInTracedScope,
 )
@@ -71,6 +74,7 @@ RULES = (
     TracedComparisonInSearch(),
     MetricsInTracedScope(),
     FaultPointInTracedScope(),
+    LedgerRecordInTracedScope(),
 )
 
 

@@ -50,6 +50,14 @@ from marl_distributedformation_tpu.obs.export import (  # noqa: F401
 from marl_distributedformation_tpu.obs.flightrec import (  # noqa: F401
     FlightRecorder,
 )
+from marl_distributedformation_tpu.obs.ledger import (  # noqa: F401
+    ProgramLedger,
+    ProgramRecord,
+    configure_ledger,
+    get_ledger,
+    load_census,
+    set_ledger,
+)
 from marl_distributedformation_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     TelemetryServer,
@@ -61,6 +69,7 @@ from marl_distributedformation_tpu.obs.sentinel import (  # noqa: F401
     RegressionSentinel,
     Watch,
     default_watches,
+    ledger_watches,
     load_bench_record,
 )
 from marl_distributedformation_tpu.obs.tracer import (  # noqa: F401
@@ -80,6 +89,8 @@ __all__ = [
     "FlightRecorder",
     "MetricsRegistry",
     "PROMETHEUS_CONTENT_TYPE",
+    "ProgramLedger",
+    "ProgramRecord",
     "RegressionSentinel",
     "Span",
     "TRACE_HEADER",
@@ -88,13 +99,18 @@ __all__ = [
     "Watch",
     "chrome_trace",
     "configure",
+    "configure_ledger",
     "configure_metrics",
     "default_watches",
     "escape_label_value",
+    "get_ledger",
     "get_registry",
     "get_tracer",
+    "ledger_watches",
     "load_bench_record",
+    "load_census",
     "new_trace_id",
+    "set_ledger",
     "prometheus_exposition",
     "sanitize_trace_id",
     "set_registry",

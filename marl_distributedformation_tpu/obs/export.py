@@ -124,6 +124,21 @@ _RUNG_KEY = re.compile(r"^rung(\d+)_(f32|bf16)_(.+)$")
 # naming discipline the rung gauges got in PR 9).
 _QUANTILE_KEY = re.compile(r"^(.+)_p(50|95|99)(_(?:ms|us|s))?$")
 _QUANTILES = {"50": "0.5", "95": "0.95", "99": "0.99"}
+# Program-ledger keys (obs/ledger.py): ``program_{key}_{field}`` folds
+# into a ``program_{field}`` family with a ``program`` label — one
+# queryable family per cost/memory/timing fact across every compiled
+# executable, instead of a key explosion per program. The field
+# alternation is the ledger's closed suffix set, so the split is
+# unambiguous whatever the program key contains.
+_PROGRAM_KEY = re.compile(
+    r"^program_(.+)_("
+    r"flops|bytes_accessed|argument_bytes|output_bytes|temp_bytes|"
+    r"alias_bytes|generated_code_bytes|trace_seconds|lower_seconds|"
+    r"compile_seconds|first_dispatch_seconds|traces_total|"
+    r"dispatches_total|dispatch_seconds_(?:p(?:50|95|99)|count|sum)"
+    r")$"
+)
+_PROGRAM_QUANTILE = re.compile(r"^dispatch_seconds_p(50|95|99)$")
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -182,7 +197,19 @@ def prometheus_exposition(
         rung_kind = _RUNG_KIND_KEY.match(key)
         rung = _RUNG_KEY.match(key)
         quantile = _QUANTILE_KEY.match(key)
-        if m:
+        program = _PROGRAM_KEY.match(key)
+        if program:
+            field = program.group(2)
+            extra = [("program", program.group(1))]
+            pq = _PROGRAM_QUANTILE.match(field)
+            if pq:
+                metric = "program_dispatch_seconds"
+                extra.append(("quantile", _QUANTILES[pq.group(1)]))
+                quantile = pq  # summary-typed family
+            else:
+                metric = f"program_{field}"
+                quantile = None
+        elif m:
             metric, extra = m.group(2), [("replica", m.group(1))]
         elif rung_kind:
             metric = f"rung_{rung_kind.group(4)}"

@@ -382,6 +382,15 @@ class TelemetryServer:
 
     def _snapshot(self) -> Dict[str, float]:
         snap = (self._registry or get_registry()).snapshot()
+        # The program ledger's families (program{...} cost/memory/
+        # dispatch gauges, device-memory watermark) ride the same
+        # merged namespace. Lazy import: ledger.py imports this module
+        # for its dispatch histograms.
+        from marl_distributedformation_tpu.obs.ledger import (
+            merge_ledger_snapshot,
+        )
+
+        merge_ledger_snapshot(snap)
         if self.extra_snapshot is not None:
             try:
                 snap.update(self.extra_snapshot())

@@ -114,6 +114,40 @@ class Watch:
             raise ValueError(f"tolerance must be > 0, got {self.tolerance}")
 
 
+def ledger_watches(tolerance: float = 0.5) -> Tuple[Watch, ...]:
+    """Program-ledger guards (obs/ledger.py) against the committed
+    record's bench phase-13 fields. Both gauges are deliberately
+    RECOVERABLE — the Watch machinery latches while breached and
+    re-arms in band, which a lifetime-cumulative value can never do:
+
+    - ``ledger_compile_seconds_max`` (not the total, which
+      legitimately grows with every curriculum-swap sampler rebuild
+      over a long run): past the record means SOME program got
+      materially more expensive to build — an XLA upgrade, an
+      accidental program split.
+    - ``device_memory_bytes_in_use`` (the instantaneous gauge, judged
+      against the committed watermark): sustained residency past the
+      recorded peak means the executables + live state no longer fit
+      the budget the autoscaler packed against; a transient swap spike
+      recovers in band instead of tripping forever.
+
+    Same trip machinery as every other watch: flightrec + audit line."""
+    return (
+        Watch(
+            gauge="ledger_compile_seconds_max",
+            bench_fields=("ledger_compile_seconds_max",),
+            direction="max",
+            tolerance=tolerance,
+        ),
+        Watch(
+            gauge="device_memory_bytes_in_use",
+            bench_fields=("device_memory_watermark_bytes",),
+            direction="max",
+            tolerance=tolerance,
+        ),
+    )
+
+
 def default_watches(tolerance: float = 0.5) -> Tuple[Watch, ...]:
     """The stock lane guards: trainer throughput, gate eval throughput,
     fleet tail latency. Generous default band — committed records are
@@ -256,7 +290,15 @@ class RegressionSentinel:
         and re-arms once it recovers inside the band."""
         registry = self._registry or get_registry()
         if snapshot is None:
-            snapshot = registry.snapshot()
+            # The default snapshot carries the program ledger's
+            # aggregate gauges too, so ledger_watches() work without
+            # every caller hand-merging namespaces (an explicit
+            # snapshot argument is taken verbatim — tests).
+            from marl_distributedformation_tpu.obs.ledger import (
+                merge_ledger_snapshot,
+            )
+
+            snapshot = merge_ledger_snapshot(registry.snapshot())
         self.checks_total += 1
         tripped_now: List[dict] = []
         for watch in self.watches:

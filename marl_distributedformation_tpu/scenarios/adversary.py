@@ -44,7 +44,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from marl_distributedformation_tpu.analysis.guards import RetraceGuard
+from marl_distributedformation_tpu.analysis.guards import (
+    RetraceGuard,
+    ledgered_jit,
+)
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.eval import (
     policy_act_fn,
@@ -175,7 +178,13 @@ def make_population_runner(
 
         return jax.vmap(one)(stacked_params)
 
-    return jax.jit(guard.wrap(population)), guard
+    run = ledgered_jit(
+        population,
+        guard,
+        subsystem="adversary",
+        program="adversary_population_eval",
+    )
+    return run, guard
 
 
 def _stack_rows(rows: Sequence[Tuple[ScenarioSpec, float]]) -> ScenarioParams:

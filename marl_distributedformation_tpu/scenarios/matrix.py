@@ -25,7 +25,10 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from marl_distributedformation_tpu.analysis.guards import RetraceGuard
+from marl_distributedformation_tpu.analysis.guards import (
+    RetraceGuard,
+    ledgered_jit,
+)
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.eval import (
     policy_act_fn,
@@ -54,7 +57,13 @@ def make_matrix_runner(
             key, act, env_params, num_formations, scenario_params
         )
 
-    return jax.jit(guard.wrap(episode)), guard
+    run = ledgered_jit(
+        episode,
+        guard,
+        subsystem="gate",
+        program="robustness_matrix_eval",
+    )
+    return run, guard
 
 
 def params_signature(params) -> Tuple:

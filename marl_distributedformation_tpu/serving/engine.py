@@ -47,7 +47,10 @@ import numpy as np
 # against its budget-1 guard and the replica circuit-breaks. Importing it
 # here means "an engine exists" implies "the config is final".
 from marl_distributedformation_tpu import jax_compat as _jax_compat  # noqa: F401
-from marl_distributedformation_tpu.analysis.guards import RetraceGuard
+from marl_distributedformation_tpu.analysis.guards import (
+    RetraceGuard,
+    ledgered_jit,
+)
 from marl_distributedformation_tpu.models import distributions
 
 # Powers-of-8-ish ladder: adjacent rungs are 8x apart, so padding waste
@@ -155,8 +158,13 @@ class BucketedPolicyEngine:
         # (donation there only emits a warning per compile), so donation
         # engages on accelerators only.
         donate = () if jax.default_backend() == "cpu" else (1, 2)
-        return jax.jit(
-            self.guards[bucket].wrap(_act), donate_argnums=donate
+        dtype_tag = "bf16" if self.dtype is not None else "f32"
+        return ledgered_jit(
+            _act,
+            self.guards[bucket],
+            subsystem="serving",
+            program=f"act_rung{bucket}_{dtype_tag}",
+            donate_argnums=donate,
         )
 
     # -- bucketing ------------------------------------------------------

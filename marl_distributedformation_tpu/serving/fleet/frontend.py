@@ -148,15 +148,21 @@ def _make_handler(router: FleetRouter):
                 if wants_prometheus(self.headers.get("Accept")):
                     # The Prometheus view is the MERGED namespace: the
                     # process registry (trainer/pipeline/checkpoint
-                    # gauges, when co-resident) plus this fleet's
-                    # families; the fleet's own keys win on overlap.
-                    # The JSON default stays byte-identical to the
-                    # router snapshot.
+                    # gauges, when co-resident) plus the program
+                    # ledger's per-executable families plus this
+                    # fleet's own families; the fleet's keys win on
+                    # overlap. The JSON default stays byte-identical
+                    # to the router snapshot.
+                    from marl_distributedformation_tpu.obs.ledger import (
+                        merge_ledger_snapshot,
+                    )
                     from marl_distributedformation_tpu.obs.metrics import (
                         get_registry,
                     )
 
-                    merged = get_registry().snapshot()
+                    merged = merge_ledger_snapshot(
+                        get_registry().snapshot()
+                    )
                     merged.update(snap)
                     self._reply_text(
                         200,

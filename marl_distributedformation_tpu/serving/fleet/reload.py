@@ -346,6 +346,16 @@ class FleetReloadCoordinator:
                     path=str(path),
                     commit_timeout_s=self.commit_timeout_s,
                 )
+        # Swap boundary: both param generations are still referenced
+        # here (staged + the replicas' previous cells), which is the
+        # transient double-residency peak the autoscaler must plan for —
+        # sample it into the ledger's watermark gauge AFTER the gates
+        # reopened, so the reading never extends the serving pause.
+        from marl_distributedformation_tpu.analysis.guards import (
+            sample_device_watermark,
+        )
+
+        sample_device_watermark(force=True)  # swaps are rare: always sample
         return True
 
     def _load_validated(self, path: Path) -> Any:

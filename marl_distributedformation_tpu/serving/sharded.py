@@ -35,12 +35,17 @@ from __future__ import annotations
 import dataclasses
 import re
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from marl_distributedformation_tpu.analysis.guards import (
+    register_aot_program,
+)
+from marl_distributedformation_tpu.obs.ledger import get_ledger
 from marl_distributedformation_tpu.serving.engine import BucketedPolicyEngine
 
 # Default rules for this repo's actor-critic family: tower kernels may
@@ -250,6 +255,9 @@ class ShardedPolicyEngine(BucketedPolicyEngine):
         # budget-1 guard.
         self._compiled: Dict[int, Any] = {}
         self._compile_lock = threading.Lock()
+        # bucket -> program-ledger dispatch key (set when the rung's
+        # AOT executable registers; see _run).
+        self._ledger_keys: Dict[int, Optional[str]] = {}
         self._seed = int(seed)
         super().__init__(
             policy,
@@ -291,6 +299,11 @@ class ShardedPolicyEngine(BucketedPolicyEngine):
             key = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
             return self._act_core(nn_params, obs, key, deterministic)
 
+        # A distinctive module name so profiles and the program ledger
+        # attribute the rung (the AOT path registers explicitly in
+        # _run, where the lowered/compiled artifacts are in hand).
+        dtype_tag = "bf16" if self.dtype is not None else "f32"
+        _act.__name__ = f"sharded_act_rung{bucket}_{dtype_tag}"
         donate = () if jax.default_backend() == "cpu" else (1,)
         return jax.jit(
             self.guards[bucket].wrap(_act), donate_argnums=donate
@@ -337,20 +350,72 @@ class ShardedPolicyEngine(BucketedPolicyEngine):
         recompiling, the same contract the RetraceGuard enforces on the
         pjit path.
         """
+        ledger = get_ledger()
         exe = self._compiled.get(bucket)
         if exe is None:
             with self._compile_lock:
                 exe = self._compiled.get(bucket)
                 if exe is None:
                     placed = jax.device_put(padded, self._batch_sharding)
-                    exe = (
-                        self._acts[bucket]
-                        .lower(nn_params, placed, key, det)
-                        .compile()
+                    t_lower = time.perf_counter()
+                    lowered = self._acts[bucket].lower(
+                        nn_params, placed, key, det
                     )
+                    t_compile = time.perf_counter()
+                    exe = lowered.compile()
+                    compile_done = time.perf_counter()
                     self._compiled[bucket] = exe
-                    return exe(nn_params, placed, key, det)
-        return exe(nn_params, padded, key, det)
+                    # The richest ledger entry in the repo: the AOT
+                    # path holds the compiled jax.stages artifact and
+                    # the measured lower/compile walls directly
+                    # (obs/ledger.py; never raises into serving).
+                    if ledger.enabled:
+                        dtype_tag = (
+                            "bf16" if self.dtype is not None else "f32"
+                        )
+                        name = f"act_rung{bucket}_{dtype_tag}_aot"
+                        try:
+                            self._ledger_keys[bucket] = (
+                                register_aot_program(
+                                    name=name,
+                                    subsystem="serving_sharded",
+                                    compiled=exe,
+                                    fingerprint=(
+                                        f"rung {bucket} x "
+                                        f"{padded.shape[-1]} obs, "
+                                        f"mesh {self.mesh.shape}"
+                                    ),
+                                    timings={
+                                        "lower_seconds": (
+                                            t_compile - t_lower
+                                        ),
+                                        "compile_seconds": (
+                                            compile_done - t_compile
+                                        ),
+                                    },
+                                )
+                            )
+                        except Exception:  # noqa: BLE001 — observe only
+                            pass
+                    t0 = time.perf_counter()
+                    out = exe(nn_params, placed, key, det)
+                    self._ledger_dispatch(
+                        ledger, bucket, time.perf_counter() - t0
+                    )
+                    return out
+        if not ledger.enabled:
+            return exe(nn_params, padded, key, det)
+        t0 = time.perf_counter()
+        out = exe(nn_params, padded, key, det)
+        self._ledger_dispatch(ledger, bucket, time.perf_counter() - t0)
+        return out
+
+    def _ledger_dispatch(
+        self, ledger: Any, bucket: int, seconds: float
+    ) -> None:
+        key = self._ledger_keys.get(bucket)
+        if key is not None:
+            ledger.dispatch(key, seconds)
 
     def _default_params(self) -> Any:
         return self._params_on_mesh

@@ -238,8 +238,12 @@ class HeteroSweepTrainer:
             "hetero_sweep_iteration",
             max_traces=config.guard_retraces or None,
         )
-        self._iteration = jax.jit(
-            self.retrace_guard.wrap(iteration_pop), donate_argnums=(0, 1)
+        self._iteration = profiling.ledgered_jit(
+            iteration_pop,
+            self.retrace_guard,
+            subsystem="hetero_sweep",
+            program="hetero_sweep_iteration",
+            donate_argnums=(0, 1),
         )
         self._fused_programs: Dict[int, Any] = {}
 
@@ -374,10 +378,13 @@ class HeteroSweepTrainer:
         length and shared by every stage with that remainder."""
         fn = self._fused_programs.get(r)
         if fn is None:
-            fn = jax.jit(
-                self.retrace_guard.wrap(
-                    make_fused_chunk(self._iteration_pop, r)
-                ),
+            # One ledger entry per DISTINCT chunk length — exactly the
+            # compile cadence the shared guard already accounts for.
+            fn = profiling.ledgered_jit(
+                make_fused_chunk(self._iteration_pop, r),
+                self.retrace_guard,
+                subsystem="hetero_sweep",
+                program=f"hetero_sweep_chunk_k{r}",
                 donate_argnums=(0, 1),
             )
             self._fused_programs[r] = fn
@@ -622,6 +629,7 @@ class HeteroSweepTrainer:
         chunk plus the stage's frozen active-agent counts). Returns
         ``(last_emitted_record, final_iteration_rewards)``."""
         host = jax.device_get(stacked)
+        profiling.sample_device_watermark()  # drain boundary (ledger)
         meter.tick(
             r * self.ppo.n_steps * self.config.num_formations
             * self.num_seeds

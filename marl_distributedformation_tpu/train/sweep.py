@@ -347,8 +347,12 @@ class SweepTrainer:
         self.retrace_guard = profiling.RetraceGuard(
             "sweep_iteration", max_traces=config.guard_retraces or None
         )
-        self._iteration = jax.jit(
-            self.retrace_guard.wrap(iteration_pop), donate_argnums=(0, 1)
+        self._iteration = profiling.ledgered_jit(
+            iteration_pop,
+            self.retrace_guard,
+            subsystem="sweep",
+            program="sweep_iteration",
+            donate_argnums=(0, 1),
         )
         self._vec_steps_since_save = 0
         self.num_envs = m * env_params.num_agents
@@ -902,6 +906,7 @@ class SweepTrainer:
         final_iteration_rewards)`` — the rewards feed the ranking
         summary."""
         host = jax.device_get(stacked)
+        profiling.sample_device_watermark()  # drain boundary (ledger)
         meter.tick(
             self._fused_chunk
             * self.ppo.n_steps

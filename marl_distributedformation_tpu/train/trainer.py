@@ -487,8 +487,16 @@ class Trainer:
             "train_iteration",
             max_traces=config.guard_retraces or None,
         )
-        self._iteration = jax.jit(
-            self.retrace_guard.wrap(dispatch_fn), donate_argnums=(0, 1)
+        # ledgered_jit == jax.jit(guard.wrap(fn)) + automatic
+        # ProgramLedger registration of the compiled executable (cost/
+        # memory facts, build timings, per-dispatch latency) — the
+        # obs/ledger.py seam every budget-1 compile site shares.
+        self._iteration = profiling.ledgered_jit(
+            dispatch_fn,
+            self.retrace_guard,
+            subsystem="trainer",
+            program="train_iteration",
+            donate_argnums=(0, 1),
         )
         self._dispatches = 0
 
@@ -529,21 +537,34 @@ class Trainer:
             sample_scenario_batch,
         )
 
-        self._sample_scenarios = jax.jit(
+        # The samplers are tiny jitted programs but programs all the
+        # same: they register in the ProgramLedger under a persistent
+        # count-only guard that survives schedule-swap rebuilds, so
+        # every sampler compile stays an attributed census entry (and
+        # the entry-count == receipt-count invariant holds).
+        if not hasattr(self, "_sampler_guard"):
+            self._sampler_guard = profiling.RetraceGuard("scenario_sampler")
+        self._sample_scenarios = profiling.ledgered_jit(
             functools.partial(
                 sample_scenario_batch,
                 specs=self._scenario_specs,
                 num_formations=self.config.num_formations,
-            )
+            ),
+            self._sampler_guard,
+            subsystem="scenarios",
+            program="scenario_sampler",
         )
-        self._sample_scenario_chunk = jax.jit(
+        self._sample_scenario_chunk = profiling.ledgered_jit(
             jax.vmap(
                 functools.partial(
                     sample_scenario_batch,
                     specs=self._scenario_specs,
                     num_formations=self.config.num_formations,
                 )
-            )
+            ),
+            self._sampler_guard,
+            subsystem="scenarios",
+            program="scenario_sampler_chunk",
         )
 
     def update_scenario_schedule(self, schedule: Any) -> None:
@@ -909,6 +930,10 @@ class Trainer:
             time.perf_counter() - t_drain
         )
         registry.counter("train_chunks_total").inc()
+        # Device-memory watermark at the drain boundary: the one host
+        # seam per chunk where a sync just happened anyway, so the
+        # sample costs no extra pipeline stall (obs/ledger.py).
+        profiling.sample_device_watermark()
         self._record_lane_metrics(meter.rate())
         per_iter = self.ppo.n_steps * self.num_envs
         last_record: Dict[str, float] = {}

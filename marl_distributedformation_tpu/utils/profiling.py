@@ -24,10 +24,15 @@ import jax
 # owns the other observability hooks. Opt-in from TrainConfig via
 # guard_retraces / guard_transfers / guard_nans.
 from marl_distributedformation_tpu.analysis.guards import (  # noqa: F401
+    LedgerDispatch,
     RetraceError,
     RetraceGuard,
+    device_memory_bytes,
+    ledgered_jit,
     nan_guard,
     no_host_transfers,
+    register_aot_program,
+    sample_device_watermark,
 )
 
 
@@ -48,7 +53,16 @@ class TraceWindow:
     Start/stop never touch the jit cache — a traced run compiles exactly
     as often as an untraced one (pinned by the profiler-under-fused
     smoke tests).
+
+    Every completed (or aborted) window appends one JSON line to
+    ``{trace_dir}/capture_ledger.jsonl`` naming what actually ran:
+    the programs dispatched during the window (from the ProgramLedger's
+    per-program dispatch counters), the chunk count, and the trace
+    directory — so a profile artifact found weeks later is attributable
+    without replaying the run.
     """
+
+    AUDIT_NAME = "capture_ledger.jsonl"
 
     def __init__(
         self,
@@ -69,6 +83,51 @@ class TraceWindow:
         self._traced = 0
         self.active = False
         self.captured = False
+        self._window_baseline: Optional[dict] = None
+
+    @staticmethod
+    def _program_dispatches() -> dict:
+        """``{dispatch_key: dispatches_total}`` from the ProgramLedger
+        (empty when the ledger is disabled)."""
+        from marl_distributedformation_tpu.obs.ledger import get_ledger
+
+        suffix = "_dispatches_total"
+        return {
+            key[len("program_"):-len(suffix)]: value
+            for key, value in get_ledger().snapshot().items()
+            if key.startswith("program_") and key.endswith(suffix)
+        }
+
+    def _audit_line(self, completed: bool) -> None:
+        """One durable line per capture window — never raises, never
+        blocks the training loop on anything but one small append."""
+        import json
+        import os
+
+        baseline, self._window_baseline = self._window_baseline, None
+        try:
+            now = self._program_dispatches()
+            programs = {
+                key: int(count - (baseline or {}).get(key, 0))
+                for key, count in now.items()
+                if count - (baseline or {}).get(key, 0) > 0
+            }
+            line = {
+                "event": "profile_capture",
+                "time": time.time(),
+                "trace_dir": self.trace_dir,
+                "completed": completed,
+                "dispatches_traced": self._traced,
+                "dispatches_skipped": self.skip,
+                "programs": programs,
+            }
+            os.makedirs(self.trace_dir, exist_ok=True)
+            with open(
+                os.path.join(self.trace_dir, self.AUDIT_NAME), "a"
+            ) as f:
+                f.write(json.dumps(line) + "\n")
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            pass
 
     def before_dispatch(self) -> None:
         """Open the window once the warmup dispatches have passed."""
@@ -78,6 +137,7 @@ class TraceWindow:
             and not self.active
             and self._dispatches >= self.skip
         ):
+            self._window_baseline = self._program_dispatches()
             jax.profiler.start_trace(self.trace_dir)
             self.active = True
             print(f"[profile] tracing -> {self.trace_dir}")
@@ -95,6 +155,7 @@ class TraceWindow:
             jax.profiler.stop_trace()
             self.active = False
             self.captured = True
+            self._audit_line(completed=True)
 
     def close(self) -> None:
         """Teardown guard for error paths: stop an open trace so the
@@ -102,6 +163,7 @@ class TraceWindow:
         if self.active:
             jax.profiler.stop_trace()
             self.active = False
+            self._audit_line(completed=False)
 
 
 @contextlib.contextmanager

@@ -100,6 +100,9 @@ PIPELINE_KEYS = (
     "telemetry",
     "telemetry_port",
     "telemetry_reservoir",
+    # program ledger (obs/ledger.py, docs/observability.md)
+    "ledger",
+    "ledger_reservoir",
     # perf-regression sentinel (obs/sentinel.py)
     "sentinel",
     "sentinel_tolerance",
@@ -265,6 +268,15 @@ def main(argv=None) -> dict:
         enabled=bool(cfg.get("telemetry", True)),
         reservoir=int(cfg.get("telemetry_reservoir", 512)),
     )
+    # Program ledger (obs/ledger.py): every compile site in the loop —
+    # trainer dispatch, gate MatrixProgram, adversary rung, serving
+    # rungs — registers its executable automatically at the
+    # RetraceGuard seam; the census lands beside promotions.jsonl at
+    # exit and the report carries entry-count == receipt-count.
+    obs_spine.configure_ledger(
+        enabled=bool(cfg.get("ledger", True)),
+        reservoir=int(cfg.get("ledger_reservoir", 256)),
+    )
     telemetry = None
     telemetry_port = cfg.get("telemetry_port")
     if telemetry_port is not None:
@@ -292,10 +304,14 @@ def main(argv=None) -> dict:
                 "registry records nothing, so no regression could "
                 "ever trip)"
             )
+        sentinel_tol = float(cfg.get("sentinel_tolerance", 0.5))
         sentinel = obs_spine.RegressionSentinel(
-            obs_spine.default_watches(
-                tolerance=float(cfg.get("sentinel_tolerance", 0.5))
-            ),
+            obs_spine.default_watches(tolerance=sentinel_tol)
+            # Ledger aggregates guard against compile-time / memory-
+            # footprint regressions vs the committed record; an older
+            # record without the fields reports as sentinel_missing,
+            # never a breach.
+            + obs_spine.ledger_watches(tolerance=sentinel_tol),
             record_path=cfg.get("sentinel_bench"),
             trip_after=int(cfg.get("sentinel_trip_after", 3)),
             audit_dir=trainer.log_dir,
@@ -496,6 +512,40 @@ def main(argv=None) -> dict:
             ),
             default=0,
         )
+        # Program ledger: every budget-1 compile site appears in the
+        # census exactly once per compile — entry count must equal the
+        # sum of the RetraceGuard receipts across the loop's programs
+        # (trainer dispatch + scenario samplers + gate eval + adversary
+        # rung + serving rungs). A mismatch means a compile escaped
+        # attribution; the report carries both sides so the e2e can pin
+        # the equality.
+        ledger = obs_spine.get_ledger()
+        if ledger.enabled:
+            receipts = trainer.retrace_guard.count
+            sampler_guard = getattr(trainer, "_sampler_guard", None)
+            if sampler_guard is not None:
+                receipts += sampler_guard.count
+            receipts += pipeline.gate.program.guard.count
+            if pipeline.gate.adversary is not None:
+                receipts += pipeline.gate.adversary.guard.count
+            receipts += sum(
+                c
+                for per in compile_receipts.values()
+                for c in per.values()
+            )
+            report["ledger_programs"] = len(ledger.entries())
+            report["ledger_receipts"] = receipts
+            report["ledger_compile_seconds_total"] = round(
+                ledger.compile_seconds_total(), 3
+            )
+            try:
+                report["ledger_census"] = str(
+                    ledger.write_census(
+                        Path(trainer.log_dir) / "program_ledger.json"
+                    )
+                )
+            except OSError:
+                pass
     finally:
         from marl_distributedformation_tpu.chaos import get_fault_plane
 

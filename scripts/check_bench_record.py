@@ -15,6 +15,18 @@ Exit 0 iff the record passes. ``--require F`` asserts float(rec[F]) > 0;
 ``--expect K=V`` asserts str(rec[K]) == V. Input parsing is shared with
 scripts/mirror_bench.py (bench.py stdout or a driver BENCH_r*.json
 wrapper), so the gate and the mirror can never disagree on a file.
+
+Census mode — the chip-window acceptance gate for the program ledger
+(obs/ledger.py, ROADMAP item 5):
+
+    python scripts/check_bench_record.py COMMITTED_census.json \
+        --census logs/run/program_ledger.json [--census-tolerance 0.25]
+
+diffs a COMMITTED census (the positional file) against the LIVE one a
+fresh run just wrote: programs that vanished or appeared, and
+flops/bytes/memory-footprint drift past the tolerance, are rejections —
+a chip re-measure must attribute every cost change, not discover it in
+a throughput regression later.
 """
 
 from __future__ import annotations
@@ -335,6 +347,143 @@ def _chaos_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _ledger_problems(rec: dict) -> list[str]:
+    """Structural validation of the program-ledger fields (bench phase
+    13), whenever present: the enabled-ledger overhead must be a finite
+    number under the 5% bar (dispatch recording is a perf_counter pair
+    plus a shard append), the census must carry at least one program (a
+    zero count means registration silently broke at every compile
+    site), and the total compile seconds must be a finite non-negative
+    number. ``"skipped"`` sentinels are honored as structurally
+    absent."""
+    problems = []
+    overhead = _present(rec, "ledger_overhead_pct")
+    if overhead is not None:
+        try:
+            v = float(overhead)
+            if not math.isfinite(v):
+                problems.append(
+                    f"ledger_overhead_pct not finite: {overhead!r}"
+                )
+            elif v >= 5.0:
+                problems.append(
+                    f"ledger_overhead_pct={v} breaches the 5% bar — "
+                    "dispatch recording must stay a perf_counter pair "
+                    "plus a per-thread shard append"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"ledger_overhead_pct is not a number: {overhead!r}"
+            )
+    count = _present(rec, "ledger_program_count")
+    if count is not None:
+        try:
+            if int(count) <= 0:
+                problems.append(
+                    f"ledger_program_count={count!r} — a measured run "
+                    "with zero registered programs means the compile-"
+                    "seam registration is broken, not that nothing "
+                    "compiled"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"ledger_program_count is not an int: {count!r}"
+            )
+    compile_s = _present(rec, "ledger_compile_seconds_total")
+    if compile_s is not None:
+        try:
+            v = float(compile_s)
+            if not math.isfinite(v) or v < 0.0:
+                problems.append(
+                    f"ledger_compile_seconds_total={compile_s!r} "
+                    "(need a finite number >= 0)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                "ledger_compile_seconds_total is not a number: "
+                f"{compile_s!r}"
+            )
+    return problems
+
+
+# -- census diff mode (the program-ledger acceptance gate) ---------------
+
+# Structural cost/memory facts whose drift the census gate bounds.
+# Build timings are deliberately excluded: compile wall is environment-
+# dependent and the RegressionSentinel already watches it live.
+CENSUS_DRIFT_FIELDS = (
+    "flops",
+    "bytes_accessed",
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+)
+
+
+def _census_index(census: dict) -> dict:
+    """Programs grouped by dispatch key (stable across replica-suffixed
+    entry keys): dispatch_key -> {count, max-per-field}."""
+    index: dict = {}
+    for prog in census.get("programs") or []:
+        key = prog.get("dispatch_key") or prog.get("key")
+        if key is None:
+            continue
+        slot = index.setdefault(key, {"count": 0})
+        slot["count"] += 1
+        for field in CENSUS_DRIFT_FIELDS:
+            try:
+                v = float(prog.get(field))
+            except (TypeError, ValueError):
+                continue
+            if field not in slot or v > slot[field]:
+                slot[field] = v
+    return index
+
+
+def census_diff(
+    committed: dict, live: dict, tolerance: float = 0.25
+) -> list[str]:
+    """Violations of the live census against the committed one: new or
+    vanished programs, and per-field relative drift past ``tolerance``.
+    Empty list == the run's compiled-program population still matches
+    the committed cost story."""
+    problems = []
+    committed_idx = _census_index(committed)
+    live_idx = _census_index(live)
+    for key in sorted(set(committed_idx) - set(live_idx)):
+        problems.append(
+            f"program vanished from the live census: {key} (committed "
+            "record has it — a compile site stopped registering or a "
+            "subsystem stopped compiling)"
+        )
+    for key in sorted(set(live_idx) - set(committed_idx)):
+        problems.append(
+            f"new program not in the committed census: {key} (commit "
+            "an updated census if the addition is intentional)"
+        )
+    for key in sorted(set(committed_idx) & set(live_idx)):
+        ref, cur = committed_idx[key], live_idx[key]
+        if ref["count"] != cur["count"]:
+            problems.append(
+                f"{key}: program count changed ({ref['count']} "
+                f"committed -> {cur['count']} live) — a replica or "
+                "compile site stopped (or started) registering under "
+                "this dispatch key"
+            )
+        for field in CENSUS_DRIFT_FIELDS:
+            a, b = ref.get(field), cur.get(field)
+            if a is None or b is None or a <= 0.0:
+                continue
+            drift = abs(b - a) / a
+            if drift > tolerance:
+                problems.append(
+                    f"{key}: {field} drifted {drift * 100.0:.0f}% "
+                    f"({a:,.0f} committed -> {b:,.0f} live; tolerance "
+                    f"{tolerance * 100.0:.0f}%)"
+                )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -353,6 +502,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_serving_slo_problems(rec))
     problems.extend(_adversarial_problems(rec))
     problems.extend(_chaos_problems(rec))
+    problems.extend(_ledger_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(
@@ -379,8 +529,30 @@ def main() -> None:
     ap.add_argument("file", type=Path)
     ap.add_argument("--require", nargs="*", default=[], metavar="FIELD")
     ap.add_argument("--expect", nargs="*", default=[], metavar="KEY=VALUE")
+    ap.add_argument(
+        "--census", type=Path, default=None, metavar="LIVE_CENSUS",
+        help="census mode: diff the committed census (the positional "
+        "file) against this live program_ledger.json",
+    )
+    ap.add_argument("--census-tolerance", type=float, default=0.25)
     args = ap.parse_args()
-    problems = check(load_record(args.file), args.require, args.expect)
+    if args.census is not None:
+        repo = str(Path(__file__).resolve().parents[1])
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from marl_distributedformation_tpu.obs.ledger import load_census
+
+        try:
+            committed = load_census(args.file)
+            live = load_census(args.census)
+        except (OSError, ValueError) as e:
+            print(f"[check_bench_record] REJECT: {e}", file=sys.stderr)
+            sys.exit(1)
+        problems = census_diff(
+            committed, live, tolerance=args.census_tolerance
+        )
+    else:
+        problems = check(load_record(args.file), args.require, args.expect)
     for p in problems:
         print(f"[check_bench_record] REJECT: {p}", file=sys.stderr)
     if problems:

@@ -342,6 +342,42 @@ def test_check_bench_record_gates():
         },
         [], [],
     ) == []
+    # Program-ledger fields (bench phase 13), validated whenever
+    # present: enabled-ledger overhead finite and under the 5% bar
+    # (negative legitimate — noise around zero), a census with at
+    # least one program, finite non-negative total compile seconds,
+    # "skipped" sentinels structurally absent.
+    ledger_ok = {
+        **clean,
+        "ledger_overhead_pct": 0.8,
+        "ledger_program_count": 11,
+        "ledger_compile_seconds_total": 42.7,
+    }
+    assert check(ledger_ok, [], []) == []
+    assert check({**ledger_ok, "ledger_overhead_pct": -0.3}, [], []) == []
+    assert check({**ledger_ok, "ledger_overhead_pct": 6.1}, [], [])
+    assert check(
+        {**ledger_ok, "ledger_overhead_pct": float("inf")}, [], []
+    )
+    assert check({**ledger_ok, "ledger_overhead_pct": "cheap"}, [], [])
+    assert check({**ledger_ok, "ledger_program_count": 0}, [], [])
+    assert check({**ledger_ok, "ledger_program_count": "many"}, [], [])
+    assert check(
+        {**ledger_ok, "ledger_compile_seconds_total": -2.0}, [], []
+    )
+    assert check(
+        {**ledger_ok, "ledger_compile_seconds_total": float("nan")},
+        [], [],
+    )
+    assert check(
+        {
+            **clean,
+            "ledger_overhead_pct": "skipped",
+            "ledger_program_count": "skipped",
+            "ledger_compile_seconds_total": "skipped",
+        },
+        [], [],
+    ) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

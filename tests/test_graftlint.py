@@ -1047,6 +1047,72 @@ FIXTURES = [
             return carry, stacked
         """,
     ),
+    (
+        # Ledger dispatch recording inside a jitted body measures the
+        # trace, not the dispatches. The good twin records at the host
+        # seam around the jitted call — the ledgered_jit discipline.
+        "ledger-record-in-traced-scope",
+        """
+        import jax
+        from marl_distributedformation_tpu.obs.ledger import get_ledger
+
+        @jax.jit
+        def step(x):
+            get_ledger().dispatch("trainer_step", 0.001)
+            return x * 2
+        """,
+        """
+        import jax
+        import time
+        from marl_distributedformation_tpu.obs.ledger import get_ledger
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def dispatch(x):
+            t0 = time.perf_counter()
+            out = step(x)
+            get_ledger().dispatch("trainer_step", time.perf_counter() - t0)
+            return out
+        """,
+    ),
+    (
+        # Same hazard one hop away inside a scan body, through a
+        # ledger-receiver chain; the good twin's helper runs at the
+        # drain seam, and an unrelated ``.register()`` receiver
+        # (atexit-shaped) stays clean.
+        "ledger-record-in-traced-scope",
+        """
+        from jax import lax
+        from marl_distributedformation_tpu.obs import ledger
+
+        def note(ledger_handle):
+            ledger_handle.record_watermark(1024.0)
+
+        def train(xs, ledger_handle):
+            def body(carry, x):
+                note(ledger_handle)
+                return carry + x, x
+            return lax.scan(body, 0.0, xs)
+        """,
+        """
+        import atexit
+        from jax import lax
+        from marl_distributedformation_tpu.obs import ledger
+
+        def note():
+            ledger.get_ledger().record_watermark(1024.0)
+
+        def train(xs, hooks):
+            def body(carry, x):
+                hooks.register(x)  # not ledger-like: stays clean
+                return carry + x, x
+            carry, stacked = lax.scan(body, 0.0, xs)
+            note()  # the drain seam: host-side
+            return carry, stacked
+        """,
+    ),
 ]
 
 

@@ -90,6 +90,18 @@ def private_registry():
     set_registry(previous)
 
 
+@pytest.fixture
+def private_ledger():
+    """A test-private ProgramLedger installed as the process-global one
+    (every ledgered compile seam resolves get_ledger() at call time)."""
+    from marl_distributedformation_tpu.obs import ProgramLedger, set_ledger
+
+    ledger = ProgramLedger(enabled=True)
+    previous = set_ledger(ledger)
+    yield ledger
+    set_ledger(previous)
+
+
 # ---------------------------------------------------------------------------
 # Incremental discovery (utils.checkpoint.CheckpointDiscovery)
 # ---------------------------------------------------------------------------
@@ -373,6 +385,9 @@ def _train_checkpoints(log_dir, iterations=3, seed=0):
         ),
     )
     trainer.train()
+    # Budget-1 receipts this run earned, for the ledger entry-count
+    # pin in the e2e (the trainer object itself is discarded).
+    _train_checkpoints.last_receipts = trainer.retrace_guard.count
     return sorted(
         log_dir.glob("rl_model_*_steps.msgpack"), key=checkpoint_step
     )
@@ -609,7 +624,9 @@ def test_gate_rebase_survives_evicted_history():
 # ---------------------------------------------------------------------------
 
 
-def test_pipeline_end_to_end(tmp_path, private_tracer, private_registry):
+def test_pipeline_end_to_end(
+    tmp_path, private_tracer, private_registry, private_ledger
+):
     assert len(jax.local_devices()) >= 2  # the conftest mesh
 
     log_dir = tmp_path / "run"
@@ -755,6 +772,40 @@ def test_pipeline_end_to_end(tmp_path, private_tracer, private_registry):
     text = prometheus_exposition(live)
     assert "# TYPE marl_pipeline_promotions_total counter" in text
     assert "# TYPE marl_pipeline_gate_eval_seconds summary" in text
+
+    # --- The program ledger (ISSUE 13 acceptance): every budget-1
+    # compile site in the loop appears in the census EXACTLY once per
+    # compilation — entry count equals the sum of the RetraceGuard
+    # receipts (trainer dispatch program + gate MatrixProgram + every
+    # serving rung on every replica), with all receipts still 1-per-
+    # program with the ledger ON. ---
+    entries = private_ledger.entries()
+    receipts = (
+        _train_checkpoints.last_receipts
+        + pipeline.gate.program.guard.count
+        + sum(
+            c
+            for per in router.compile_counts().values()
+            for c in per.values()
+        )
+    )
+    assert len(entries) == receipts
+    assert all(rec.traces == 1 for rec in entries)
+    subsystems = {rec.subsystem for rec in entries}
+    assert {"trainer", "gate", "serving"} <= subsystems
+    # Facts are present-or-explicitly-unavailable, never silently blank.
+    from marl_distributedformation_tpu.obs.ledger import ANALYSIS_SOURCES
+
+    for rec in entries:
+        assert rec.analysis_source in ANALYSIS_SOURCES
+        if rec.analysis_source == "unavailable":
+            assert rec.analysis_error
+    # The ledger families fold into the same exposition namespace.
+    ledger_text = prometheus_exposition(
+        {**live, **private_ledger.snapshot()}
+    )
+    assert "# TYPE marl_program_flops gauge" in ledger_text
+    assert 'program="gate_robustness_matrix_eval"' in ledger_text
 
     # --- The obs spine (ISSUE 8 acceptance): ONE trace reconstructs a
     # promotion end to end, and its span decomposition sums to the

@@ -322,12 +322,22 @@ def main(argv=None) -> None:
     # bare training run is scrapeable without a serving fleet.
     from marl_distributedformation_tpu.obs import (
         TelemetryServer,
+        configure_ledger,
         configure_metrics,
+        get_ledger,
     )
 
     configure_metrics(
         enabled=bool(cfg.get("telemetry", True)),
         reservoir=int(cfg.get("telemetry_reservoir", 512)),
+    )
+    # Program ledger (obs/ledger.py): every compile this run performs
+    # registers its executable's cost/memory facts; the census lands
+    # beside the checkpoints at exit for program_report.py / the
+    # chip-window census gate.
+    configure_ledger(
+        enabled=bool(cfg.get("ledger", True)),
+        reservoir=int(cfg.get("ledger_reservoir", 256)),
     )
     telemetry = None
     if cfg.get("telemetry_port") is not None:
@@ -344,6 +354,17 @@ def main(argv=None) -> None:
     finally:
         if telemetry is not None:
             telemetry.stop()
+        ledger = get_ledger()
+        if ledger.enabled and ledger.entries():
+            from pathlib import Path as _Path
+
+            try:
+                path = ledger.write_census(
+                    _Path(trainer.log_dir) / "program_ledger.json"
+                )
+                print(f"[train] program ledger census -> {path}")
+            except OSError as e:
+                print(f"[train] census write failed: {e!r}")
     print(f"[train] done at {trainer.num_timesteps} steps: {final}")
 
 
