@@ -74,6 +74,15 @@ Measures, inside one process and one JSON line:
   compiled executables registered and their attributed backend-compile
   wall. The census itself is what a chip window commits beside this
   record (``check_bench_record.py --census``).
+- ``mesh_req_per_sec`` / ``mesh_global_swap_latency_s_p50``/``_p95`` /
+  ``mesh_failover_lost_requests``: the cross-host tier
+  (serving/mesh/, docs/mesh.md) — a loopback 2-host mesh (real host
+  SUBPROCESSES behind the MetaRouter) hammered by client threads
+  while the coordinator drives global barrier swaps and one host is
+  killed with a real SIGKILL mid-load. Lost requests MUST be 0, step
+  monotonicity must hold across hosts (``mesh_step_violations`` == 0),
+  and every surviving host's compile receipts stay at 1
+  (``mesh_host_compile_receipts_max``).
 
 Phases skipped via
   ``BENCH_SKIP_*`` env vars record the explicit ``"skipped"`` sentinel
@@ -106,7 +115,9 @@ BENCH_SLO_P95_MS, BENCH_SKIP_ADVERSARIAL=1, BENCH_ADV_M,
 BENCH_ADV_ITERS, BENCH_ADV_EVAL_M, BENCH_TELEMETRY_CHUNK,
 BENCH_TELEMETRY_PASSES, BENCH_SENTINEL_CHECKS, BENCH_SKIP_CHAOS=1,
 BENCH_CHAOS_SEED, BENCH_CHAOS_FAULTS, BENCH_LEDGER_CHUNK,
-BENCH_LEDGER_PASSES (the ledger phase shares BENCH_SKIP_TRAIN).
+BENCH_LEDGER_PASSES (the ledger phase shares BENCH_SKIP_TRAIN),
+BENCH_SKIP_MESH=1, BENCH_MESH_HOSTS, BENCH_MESH_DURATION_S,
+BENCH_MESH_SWAPS.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -2008,6 +2019,77 @@ def main() -> None:
                 notes.append(f"ledger phase failed: {e!r}"[:200])
         else:
             notes.append("ledger phase skipped: deadline")
+
+        # --- Phase 14: the mesh tier (serving/mesh/, docs/mesh.md):
+        # a loopback 2-host mesh — real host subprocesses behind the
+        # MetaRouter — hammered by client threads while the
+        # coordinator drives global barrier swaps and one host eats a
+        # real SIGKILL mid-load. Headlines: mesh_req_per_sec,
+        # mesh_global_swap_latency_s_p50/p95 (wall of the two-phase
+        # prepare+commit across every host, under load),
+        # mesh_failover_lost_requests (MUST be 0 — the
+        # no-accepted-request-lost invariant across a host death), and
+        # the per-host budget-1 receipts.
+        mesh_fields = (
+            "mesh_req_per_sec",
+            "mesh_global_swap_latency_s_p50",
+            "mesh_global_swap_latency_s_p95",
+            "mesh_failover_lost_requests",
+        )
+        if os.environ.get("BENCH_SKIP_MESH") == "1":
+            _mark_skipped(result, "mesh", mesh_fields)
+        elif time.time() < deadline - 90:
+            try:
+                import tempfile
+
+                from marl_distributedformation_tpu.serving.mesh.smoke import (  # noqa: E501
+                    run_mesh_smoke,
+                )
+
+                smoke = run_mesh_smoke(
+                    tempfile.mkdtemp(prefix="bench_mesh_"),
+                    hosts=_env_int("BENCH_MESH_HOSTS", 2),
+                    duration_s=float(
+                        os.environ.get("BENCH_MESH_DURATION_S", "8")
+                    ),
+                    swaps=_env_int("BENCH_MESH_SWAPS", 3),
+                    ready_timeout_s=max(
+                        30.0, deadline - time.time() - 30.0
+                    ),
+                )
+                result["mesh_hosts"] = smoke["mesh_hosts"]
+                result["mesh_req_per_sec"] = smoke["mesh_req_per_sec"]
+                for key in (
+                    "mesh_global_swap_latency_s_p50",
+                    "mesh_global_swap_latency_s_p95",
+                ):
+                    if smoke.get(key) is not None:
+                        result[key] = smoke[key]
+                result["mesh_failover_lost_requests"] = smoke[
+                    "mesh_failover_lost_requests"
+                ]
+                result["mesh_step_violations"] = smoke[
+                    "mesh_step_violations"
+                ]
+                result["mesh_global_swaps"] = smoke["mesh_global_swaps"]
+                result["mesh_host_compile_receipts_max"] = smoke[
+                    "mesh_host_compile_receipts_max"
+                ]
+                print(
+                    "[bench] mesh (2-host loopback): "
+                    f"{smoke['mesh_req_per_sec']:,.0f} req/s, "
+                    f"{smoke['mesh_global_swaps']} global swaps "
+                    f"(p50 {smoke.get('mesh_global_swap_latency_s_p50')}"
+                    "s), host killed "
+                    f"{smoke['mesh_host_killed']!r}, "
+                    f"{smoke['mesh_failover_lost_requests']} lost, "
+                    f"{smoke['mesh_step_violations']} step violations",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                notes.append(f"mesh phase failed: {e!r}"[:200])
+        else:
+            notes.append("mesh phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

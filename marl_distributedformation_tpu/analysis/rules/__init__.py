@@ -38,6 +38,9 @@ from marl_distributedformation_tpu.analysis.rules.metrics_scope import (
 from marl_distributedformation_tpu.analysis.rules.numpy_use import NumpyInJit
 from marl_distributedformation_tpu.analysis.rules.printing import PrintInJit
 from marl_distributedformation_tpu.analysis.rules.prng import PrngKeyReuse
+from marl_distributedformation_tpu.analysis.rules.rpc_scope import (
+    RpcInTracedScope,
+)
 from marl_distributedformation_tpu.analysis.rules.scan_carry import (
     ScanCarryWeakType,
 )
@@ -75,6 +78,7 @@ RULES = (
     MetricsInTracedScope(),
     FaultPointInTracedScope(),
     LedgerRecordInTracedScope(),
+    RpcInTracedScope(),
 )
 
 

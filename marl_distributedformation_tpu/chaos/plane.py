@@ -90,6 +90,18 @@ INJECTION_POINTS: Dict[str, Tuple[str, ...]] = {
     "scheduler.dispatch": ("crash", "delay"),
     # serving/fleet/frontend HTTP handler.
     "frontend.handler": ("raise", "delay"),
+    # serving/mesh — the cross-host tier's control-plane seams.
+    # Coordinator side: the barrier RPC legs (prepare/commit round
+    # trips) and the heartbeat handler; a delay here stretches a
+    # global commit, a raise aborts the round (every host restored).
+    "mesh.rpc": ("raise", "delay"),
+    "mesh.heartbeat": ("raise", "delay"),
+    # Host-agent side: the staged two-phase handlers. A wedge on
+    # mesh.prepare is the canonical wedged-host case — the
+    # coordinator's prepare timeout must abort the WHOLE round and
+    # every host must resume on the old step.
+    "mesh.prepare": ("wedge", "raise", "delay"),
+    "mesh.commit": ("raise", "delay"),
 }
 
 

@@ -48,12 +48,18 @@ from marl_distributedformation_tpu.utils.checkpoint import checkpoint_step
 #       supervisor feeding a rejection's falsifiers back into the
 #       trainer's schedule (and ``curriculum_update_failed`` when the
 #       trainer has no scenario seam to feed).
-PROMOTIONS_SCHEMA = 3
+#   4 — mesh tier (serving/mesh/): ``promoted`` and ``rolled_back``
+#       lines carry ``host_count`` (hosts the coordinator's barrier
+#       round committed — 1 for a single-host fleet) and
+#       ``commit_round`` (the coordinator's monotone round number), so
+#       the audit log attributes every swap to the cross-host commit
+#       that served it.
+PROMOTIONS_SCHEMA = 4
 
 # Schemas the reader accepts. Older lines stay readable forever: the
-# reader backfills ``trace_id``/``spans`` (schema 2) and ``falsifiers``
-# (schema 3) as None.
-READABLE_SCHEMAS = (1, 2, 3)
+# reader backfills ``trace_id``/``spans`` (schema 2), ``falsifiers``
+# (schema 3), and ``host_count``/``commit_round`` (schema 4) as None.
+READABLE_SCHEMAS = (1, 2, 3, 4)
 
 
 class PromotionLog:
@@ -110,6 +116,11 @@ class PromotionLog:
             # the adversarial rung RAN — readers get None, never a
             # KeyError, whichever way the gate was configured.
             rec.setdefault("falsifiers", None)
+            # Same discipline for the schema-4 commit attribution:
+            # non-swap events (rejections, curriculum updates) never
+            # carry them either.
+            rec.setdefault("host_count", None)
+            rec.setdefault("commit_round", None)
             records.append(rec)
         return records
 

@@ -389,6 +389,17 @@ class AlwaysLearningPipeline:
             registry.histogram("promotion_latency_seconds").observe(latency)
         if self.monitor is not None:
             self.monitor.reset()
+        # Schema-4 commit attribution: which coordinator round served
+        # this candidate and how many hosts it committed (1 for a
+        # single-host fleet; the mesh coordinator reports the real
+        # round's host count). Only claimed when the newest landed
+        # commit is EXACTLY this candidate's step — an aborted refresh
+        # (benign at line level, the deferred path owns it) must not
+        # stamp this promotion with the PREVIOUS round's attribution.
+        # No fleet attached yet -> None.
+        commit = getattr(self.coordinator, "last_commit", None) or {}
+        if commit.get("step") != verdict.step:
+            commit = {}
         self.log.append(
             "promoted",
             **verdict.record(),
@@ -398,6 +409,8 @@ class AlwaysLearningPipeline:
             promotion_latency_s=(
                 round(latency, 4) if latency is not None else None
             ),
+            host_count=commit.get("host_count"),
+            commit_round=commit.get("commit_round"),
         )
 
     def _retry_deferred(self) -> None:
@@ -529,7 +542,16 @@ class AlwaysLearningPipeline:
         registry = get_registry()
         registry.counter("pipeline_rollbacks_total").inc()
         registry.gauge("pipeline_served_step").set(last_good.step)
-        self.log.append("rolled_back", **entry, trace_id=rollback_trace)
+        commit = getattr(self.coordinator, "last_commit", None) or {}
+        if commit.get("step") != last_good.step:
+            commit = {}  # attribution must be THIS demotion's round
+        self.log.append(
+            "rolled_back",
+            **entry,
+            trace_id=rollback_trace,
+            host_count=commit.get("host_count"),
+            commit_round=commit.get("commit_round"),
+        )
         return True
 
     def poll_once(self) -> int:

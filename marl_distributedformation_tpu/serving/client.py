@@ -16,21 +16,33 @@ every well-behaved caller needs:
 
 The client is duck-typed over its target: anything with ``submit`` /
 ``default_timeout_s`` works, which is exactly the surface
-``MicroBatchScheduler`` and ``fleet.FleetRouter`` share — the same
-client code talks to one engine or a whole fleet.
+``MicroBatchScheduler``, ``fleet.FleetRouter``, and
+``mesh.MetaRouter`` share — the same client code talks to one engine,
+a whole fleet, or a whole mesh.
+
+**HTTP endpoints**: the target may instead be a base-URL string (or a
+LIST of them — a fleet of frontends / mesh hosts). The client then
+speaks the frontends' ``POST /v1/act`` protocol with client-side
+failover: connection-refused and 5xx answers rotate to the next
+endpoint, drawing from the SAME capped full-jitter retry budget as
+backpressure — a dead frontend costs one attempt, never the whole
+budget burned against one address.
 """
 
 from __future__ import annotations
 
+import http.client
+import json
 import random
 import time
-from typing import Callable, Optional, Tuple
+from typing import Callable, List, Optional, Tuple, Union
 
 import numpy as np
 
-from marl_distributedformation_tpu.obs import new_trace_id
+from marl_distributedformation_tpu.obs import TRACE_HEADER, new_trace_id
 from marl_distributedformation_tpu.serving.scheduler import (
     BackpressureError,
+    RequestTimeout,
     ServedResult,
 )
 
@@ -72,13 +84,35 @@ def backoff_s(
 class ServingClient:
     def __init__(
         self,
-        scheduler,
+        scheduler: Union[object, str, List[str]],
         max_retries: int = 3,
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 2.0,
         jitter: bool = True,
         rng: Optional[random.Random] = None,
+        default_timeout_s: float = 10.0,
     ) -> None:
+        # A base-URL string (or a list of them) selects HTTP mode:
+        # failover rotates over the endpoints on connection errors and
+        # 5xx answers, sharing the one retry budget below.
+        self._endpoints: Optional[List[str]] = None
+        if isinstance(scheduler, str):
+            self._endpoints = [scheduler.rstrip("/")]
+        elif isinstance(scheduler, (list, tuple)):
+            # A list is ALWAYS the endpoint form — a stray None from
+            # unresolved config must fail here, loudly, not as an
+            # AttributeError on the first predict.
+            if not scheduler:
+                raise ValueError("need at least one endpoint URL")
+            bad = [e for e in scheduler if not isinstance(e, str)]
+            if bad:
+                raise TypeError(
+                    f"endpoint list must be base-URL strings; got "
+                    f"{bad[0]!r}"
+                )
+            self._endpoints = [e.rstrip("/") for e in scheduler]
+        self._endpoint_idx = 0
+        self.default_timeout_s = float(default_timeout_s)
         self.scheduler = scheduler
         self.max_retries = max_retries
         self.backoff_base_s = backoff_base_s
@@ -117,6 +151,10 @@ class ServingClient:
         trace_id: Optional[str] = None,
         slo_class: str = "interactive",
     ) -> ServedResult:
+        if self._endpoints is not None:
+            return self._predict_http(
+                obs, deterministic, timeout_s, trace_id, slo_class
+            )
         wait_s = (
             timeout_s
             if timeout_s is not None
@@ -154,3 +192,121 @@ class ServingClient:
                     )
                 )
         raise AssertionError("unreachable")  # pragma: no cover
+
+    # -- HTTP endpoint mode ----------------------------------------------
+
+    def _predict_http(
+        self,
+        obs: np.ndarray,
+        deterministic: bool,
+        timeout_s: Optional[float],
+        trace_id: Optional[str],
+        slo_class: str,
+    ) -> ServedResult:
+        """``POST /v1/act`` against the endpoint list with client-side
+        failover. One retry budget covers everything: a 429 consumes an
+        attempt and sleeps the jittered backoff floored at the server's
+        hint; a connection-refused or 5xx consumes an attempt and
+        ROTATES to the next endpoint (so a dead frontend costs exactly
+        one attempt per pass, never the whole budget); a 400/504 is the
+        caller's own outcome and surfaces immediately."""
+        wait_s = (
+            timeout_s if timeout_s is not None else self.default_timeout_s
+        )
+        trace_id = trace_id or new_trace_id()
+        body = json.dumps(
+            {
+                "obs": np.asarray(obs, np.float32).tolist(),
+                "deterministic": bool(deterministic),
+                "timeout_s": wait_s,
+                "slo_class": slo_class,
+            }
+        ).encode()
+        last_error: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            url = self._endpoints[
+                self._endpoint_idx % len(self._endpoints)
+            ]
+            retry_after = 0.0
+            try:
+                status, payload = self._post_act(
+                    url, body, trace_id, wait_s
+                )
+            except (OSError, http.client.HTTPException) as e:
+                # Nobody answered: fail over to the next address. The
+                # backoff (no server hint: pure jittered exponential)
+                # still applies so a fully-dead list backs off instead
+                # of spinning.
+                self._endpoint_idx += 1
+                last_error = ConnectionError(
+                    f"{url} unreachable: {e!r}"
+                )
+            else:
+                if status == 200:
+                    return ServedResult(
+                        actions=np.asarray(
+                            payload["actions"], np.float32
+                        ),
+                        model_step=int(payload["model_step"]),
+                        latency_s=float(payload.get("latency_s", 0.0)),
+                        replica=int(payload.get("replica", -1)),
+                    )
+                if status == 429:
+                    retry_after = float(
+                        payload.get("retry_after_s", 0.1)
+                    )
+                    # Another frontend may have capacity RIGHT NOW —
+                    # rotate, and only honor THIS endpoint's drain
+                    # estimate as a sleep floor when there is nowhere
+                    # else to go (sleeping a busy host's quote before
+                    # trying an idle peer pays the wrong bill).
+                    self._endpoint_idx += 1
+                    last_error = BackpressureError(retry_after)
+                    if len(self._endpoints) > 1:
+                        retry_after = 0.0
+                elif status == 400:
+                    raise ValueError(
+                        str(payload.get("error", "bad request"))
+                    )
+                elif status == 504:
+                    raise RequestTimeout(
+                        str(payload.get("error", "deadline passed"))
+                    )
+                else:  # 5xx: that frontend is sick — rotate
+                    self._endpoint_idx += 1
+                    last_error = ConnectionError(
+                        f"{url} answered {status}: "
+                        f"{payload.get('error', '')!r}"
+                    )
+            if attempt == self.max_retries:
+                raise last_error
+            time.sleep(
+                backoff_s(
+                    attempt,
+                    retry_after,
+                    self.backoff_base_s,
+                    self.backoff_cap_s,
+                    jitter=self._rng.random if self.jitter else None,
+                )
+            )
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _post_act(
+        self, base_url: str, body: bytes, trace_id: str, wait_s: float
+    ) -> Tuple[int, dict]:
+        # Shared transport core (serving/mesh/rpc.py): one place to fix
+        # connection handling for this client, the MetaRouter forward,
+        # and the mesh RPC alike. Wait slack mirrors the frontends'
+        # own: the server fails expired requests itself.
+        from marl_distributedformation_tpu.serving.mesh.rpc import (
+            post_json,
+        )
+
+        status, payload, _ = post_json(
+            base_url,
+            "/v1/act",
+            body,
+            headers={TRACE_HEADER: trace_id},
+            timeout_s=wait_s + 10.0,
+        )
+        return status, payload

@@ -98,11 +98,18 @@ class FleetMetrics:
         # tracing spine sees the sharded/bf16 engines through the
         # existing ``GET /v1/metrics`` endpoint.
         rungs: Dict[str, float] = {}
+        drain_s = 0.0
         for r in replicas:
             m = r.scheduler.metrics
             snap = m.snapshot()
             healthy += int(r.healthy)
             merged.extend(m.latencies_snapshot())
+            # Host-level backlog estimate: the sum of every replica's
+            # drain time. Rides the mesh heartbeat as the gossip field
+            # the MetaRouter routes on (serving/mesh/router.py) — the
+            # same join-the-shortest-TIME-queue quantity the fleet
+            # router uses per replica, aggregated per host.
+            drain_s += float(r.scheduler.estimated_drain_s())
             out[f"replica{r.index}_routed"] = float(routed.get(r.index, 0))
             out[f"replica{r.index}_requests"] = snap["requests"]
             out[f"replica{r.index}_occupancy_pct"] = snap[
@@ -134,6 +141,7 @@ class FleetMetrics:
                     rungs[ckey] = max(rungs.get(ckey, 0.0), float(count))
         out.update(rungs)
         out["fleet_healthy_replicas"] = float(healthy)
+        out["fleet_estimated_drain_s"] = drain_s
         ordered = sorted(merged)
         pct = ServingMetrics._percentile
         out["latency_p50_ms"] = 1e3 * pct(ordered, 0.50)

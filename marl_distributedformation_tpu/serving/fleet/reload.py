@@ -162,9 +162,20 @@ class FleetReloadCoordinator:
         self.poll_interval_s = poll_interval_s
         self.commit_timeout_s = commit_timeout_s
         self.swap_count = 0
+        # Host-count/commit-round attribution of the newest landed swap
+        # (promotions.jsonl schema 4). A single-host fleet always
+        # commits 1 host; the mesh coordinator's global commit mirrors
+        # this attribute with the real host count and round number.
+        self.last_commit: Optional[dict] = None
         self.load_errors: Deque[Tuple[str, str]] = deque(
             maxlen=max_recorded_errors
         )
+        # Cross-host staged state (prepare_global/commit_prepared): the
+        # mesh coordinator's two-phase barrier holds this host paused —
+        # gates closed, every replica barrier held, new params staged —
+        # between the prepare ack and the commit/abort decision.
+        self._staged: Optional[dict] = None
+        self._staged_lock = threading.Lock()
         # Incremental discovery: a long-running watcher polls this
         # directory forever, and re-listing + re-parsing every historic
         # checkpoint each poll degrades O(total checkpoints). Same
@@ -310,6 +321,11 @@ class FleetReloadCoordinator:
                     installed.append((r, prev))
                 self._fleet_step = step
                 self.swap_count += 1
+                self.last_commit = {
+                    "commit_round": self.swap_count,
+                    "host_count": 1,
+                    "step": step,
+                }
         except Exception as e:  # noqa: BLE001 — contain + untear
             # A failure mid-commit (an injected fault, a broken
             # registry) must not leave a TORN swap: some replicas on
@@ -378,6 +394,261 @@ class FleetReloadCoordinator:
         return restore_state_dict_partial(
             raw, template, origin=str(path)
         )["params"]
+
+    # -- cross-host staged two-phase (serving/mesh) ----------------------
+    #
+    # The mesh coordinator generalizes the batch-barrier commit across
+    # hosts: it cannot hold every host's locks itself, so each host
+    # splits _load_and_commit at the commit point. ``prepare_global``
+    # does everything UP TO the pointer flip — restore + validate once,
+    # stage per-replica uploads, close the gates, acquire every replica
+    # barrier — then HOLDS that state (the host serves nothing) until
+    # the coordinator decides: ``commit_prepared`` flips every cell and
+    # resumes, ``abort_prepared`` resumes on the old step. Because every
+    # host pauses before any host commits, no old-step response can
+    # complete after a new-step response anywhere — model_step stays
+    # globally monotonic in response completion order across the mesh.
+    # ``ttl_s`` bounds an orphaned prepare (coordinator died mid-round):
+    # the host auto-aborts and keeps serving the old step rather than
+    # staying paused forever.
+
+    def prepare_global(
+        self,
+        path: str | Path,
+        step: Optional[int] = None,
+        monotonic: bool = True,
+        trace_id: Optional[str] = None,
+        ttl_s: Optional[float] = 60.0,
+    ) -> Tuple[bool, str]:
+        """Phase 1 of the cross-host swap: stage + pause. Returns
+        ``(staged, reason)``; on False the host is untouched and keeps
+        serving. The refresh lock stays held across a successful
+        prepare so no local reload can interleave with the mesh round —
+        commit/abort release it."""
+        path = Path(path)
+        # Refuse FAST when the lock is busy instead of parking: the
+        # refresh lock is only held long while a round is staged, and
+        # a prepare that blocks past the coordinator's RPC timeout
+        # becomes a zombie — its late "staged" ack lands after the
+        # round aborted, wedging the NEXT round in turn. A quick typed
+        # refusal lets the coordinator abort-and-clear and retry.
+        if not self._refresh_lock.acquire(timeout=0.25):
+            with self._staged_lock:
+                staleness = (
+                    f" (round {self._staged['round_tag']} is staged "
+                    "here awaiting commit/abort)"
+                    if self._staged is not None
+                    else ""
+                )
+            return False, f"another reload holds the refresh lock{staleness}"
+        staged_ok = False
+        try:
+            with self._staged_lock:
+                if self._staged is not None:
+                    return False, (
+                        f"round {self._staged['round_tag']} is already "
+                        "staged on this host (commit or abort it first)"
+                    )
+            try:
+                step = checkpoint_step(path) if step is None else int(step)
+            except ValueError as e:
+                self.load_errors.append((str(path), repr(e)))
+                return False, f"unparseable checkpoint name: {e}"
+            if monotonic and step <= self._fleet_step:
+                return False, (
+                    f"stale step {step} <= served {self._fleet_step}"
+                )
+            if step == self._fleet_step:
+                return False, f"already serving step {step}"
+            tracer = get_tracer()
+            try:
+                with tracer.span(
+                    "reload.load", trace_id=trace_id, step=step,
+                    path=str(path),
+                ):
+                    restored = self._load_validated(path)
+            except Exception as e:  # noqa: BLE001 — serving must not die
+                self.load_errors.append((str(path), repr(e)))
+                return False, f"load failed: {e!r}"
+            import jax
+
+            with tracer.span(
+                "reload.stage", trace_id=trace_id, step=step
+            ):
+                staged = [
+                    (r, jax.device_put(restored, r.registry.device))
+                    for r in self.router.replicas
+                ]
+            barriers = [r.registry.batch_lock for r, _ in staged]
+            held = []
+            try:
+                for b in barriers:
+                    b.close()
+                for i, b in enumerate(barriers):
+                    fault_point("fleet.barrier")
+                    t_acq = time.perf_counter()
+                    acquired = b.acquire(timeout=self.commit_timeout_s)
+                    tracer.add_span(
+                        "reload.barrier_acquire",
+                        t_acq,
+                        time.perf_counter(),
+                        trace_id=trace_id,
+                        replica=i,
+                        acquired=acquired,
+                    )
+                    if not acquired:
+                        reason = (
+                            f"prepare aborted: replica {i} barrier not "
+                            f"acquired in {self.commit_timeout_s}s "
+                            "(wedged dispatch?); old step keeps serving"
+                        )
+                        self.load_errors.append((str(path), reason))
+                        tracer.incident(
+                            "wedged_barrier_abort",
+                            trace_id=trace_id,
+                            replica=i,
+                            step=step,
+                            path=str(path),
+                            commit_timeout_s=self.commit_timeout_s,
+                        )
+                        return False, reason
+                    held.append(b)
+            except BaseException as e:
+                # Untear like _load_and_commit: an exception with gates
+                # closed (an armed fleet.barrier fault, a broken
+                # registry) must not leave the host paused forever —
+                # the only finally below releases the refresh lock,
+                # not these.
+                reason = f"prepare aborted mid-acquisition: {e!r}"
+                self.load_errors.append((str(path), reason))
+                if isinstance(e, Exception):
+                    return False, reason
+                raise  # SimulatedCrash-grade: die, but gates reopened
+            finally:
+                landed = len(held) == len(barriers)
+                if not landed:
+                    for h in reversed(held):
+                        h.release()
+                    for b in barriers:
+                        b.open()
+            timer: Optional[threading.Timer] = None
+            entry = {
+                "round_tag": f"step{step}",
+                "path": path,
+                "step": step,
+                "staged": staged,
+                "barriers": barriers,
+                "held": held,
+                "trace_id": trace_id,
+                "timer": None,
+            }
+            if ttl_s is not None:
+                timer = threading.Timer(
+                    ttl_s, self._ttl_abort, args=(entry,)
+                )
+                timer.daemon = True
+                entry["timer"] = timer
+            with self._staged_lock:
+                self._staged = entry
+            if timer is not None:
+                timer.start()
+            staged_ok = True
+            return True, f"staged step {step}"
+        finally:
+            if not staged_ok:
+                self._refresh_lock.release()
+
+    def _take_staged(self) -> Optional[dict]:
+        with self._staged_lock:
+            entry, self._staged = self._staged, None
+        if entry is not None and entry["timer"] is not None:
+            entry["timer"].cancel()
+        return entry
+
+    def commit_prepared(self, trace_id: Optional[str] = None) -> bool:
+        """Phase 2: flip every staged replica and resume. Returns False
+        when nothing is staged (an aborted/TTL-expired round — the
+        coordinator treats that as this host having dropped out)."""
+        entry = self._take_staged()
+        if entry is None:
+            return False
+        tracer = get_tracer()
+        installed = []
+        try:
+            with tracer.span(
+                "reload.commit",
+                trace_id=trace_id or entry["trace_id"],
+                step=entry["step"],
+                replicas=len(entry["staged"]),
+            ):
+                for r, params in entry["staged"]:
+                    prev = r.registry.active()
+                    fault_point("registry.swap")
+                    r.registry.install(params, entry["step"])
+                    installed.append((r, prev))
+                self._fleet_step = entry["step"]
+                self.swap_count += 1
+        except Exception as e:  # noqa: BLE001 — contain + untear
+            for r, (prev_params, prev_step) in reversed(installed):
+                r.registry.install(prev_params, prev_step)
+            self.load_errors.append(
+                (
+                    str(entry["path"]),
+                    f"staged commit aborted mid-swap and rolled back: "
+                    f"{e!r}; old step keeps serving",
+                )
+            )
+            return False
+        finally:
+            for b in reversed(entry["held"]):
+                b.release()
+            for b in entry["barriers"]:
+                b.open()
+            self._refresh_lock.release()
+        from marl_distributedformation_tpu.analysis.guards import (
+            sample_device_watermark,
+        )
+
+        sample_device_watermark(force=True)
+        return True
+
+    def abort_prepared(self, reason: str = "") -> bool:
+        """Resume on the old step without installing anything (the
+        coordinator's round failed on some other host, or the local
+        TTL expired). Always safe to call; returns False when nothing
+        was staged."""
+        entry = self._take_staged()
+        if entry is None:
+            return False
+        for b in reversed(entry["held"]):
+            b.release()
+        for b in entry["barriers"]:
+            b.open()
+        self._refresh_lock.release()
+        if reason:
+            self.load_errors.append(
+                (str(entry["path"]), f"prepare aborted: {reason}")
+            )
+        return True
+
+    def _ttl_abort(self, entry: dict) -> None:
+        """An orphaned prepare (no commit/abort before the TTL): the
+        coordinator is gone — resume serving the OLD step rather than
+        stay paused forever. Guarded against racing a landing commit:
+        only fires if this exact entry is still the staged one."""
+        with self._staged_lock:
+            if self._staged is not entry:
+                return  # commit/abort won the race
+        self.abort_prepared(
+            "prepare TTL expired with no commit/abort — coordinator "
+            "presumed dead; serving resumed on the old step"
+        )
+        get_tracer().incident(
+            "orphaned_prepare_abort",
+            trace_id=entry["trace_id"],
+            step=entry["step"],
+            path=str(entry["path"]),
+        )
 
     # -- background watcher ---------------------------------------------
 

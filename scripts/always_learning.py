@@ -85,6 +85,16 @@ PIPELINE_KEYS = (
     "pipeline_poll_s",
     "pipeline_budget_s",
     "pipeline_verify_requests",
+    # mesh tier (serving/mesh/, docs/mesh.md): serve through a loopback
+    # multi-host mesh — host subprocesses behind the MetaRouter, the
+    # MeshCoordinator driving every promotion as a global barrier commit.
+    "mesh_serve",
+    "mesh_hosts",
+    "mesh_heartbeat_s",
+    "mesh_lease_s",
+    "mesh_dead_after_s",
+    "mesh_prepare_timeout_s",
+    "mesh_port",
     # rollback
     "rollback_metric",
     "rollback_threshold",
@@ -176,6 +186,15 @@ def _monitor(cfg, router):
     from marl_distributedformation_tpu.obs import get_registry
     from marl_distributedformation_tpu.pipeline import RollbackMonitor
 
+    direction = str(cfg.get("rollback_direction") or "above")
+    # Mesh mode: the fleet families live in the HOST subprocesses and
+    # reach this process only as gossip (MeshHost.metrics). A
+    # fleet-snapshot metric name is resolved as the WORST value across
+    # routable hosts — max for an "above"-breaching metric (latency,
+    # queue depth), min for "below" (served return) — so the tripwire
+    # fires when ANY host regresses, never silently reads None.
+    coordinator = getattr(router, "coordinator", None)
+
     def sample():
         # One sampling code path fleet-wide (obs/metrics.py): the
         # router snapshot refreshes the fleet gauges in the process
@@ -191,6 +210,16 @@ def _monitor(cfg, router):
         snap = router.snapshot()
         merged = get_registry().snapshot()
         merged.update(snap)
+        if coordinator is not None and metric not in merged:
+            values = []
+            for h in coordinator.routable_hosts():
+                v = (h.metrics or {}).get(metric)
+                if isinstance(v, (int, float)):
+                    values.append(float(v))
+            if values:
+                merged[metric] = (
+                    max(values) if direction == "above" else min(values)
+                )
         return merged
 
     return RollbackMonitor(
@@ -352,6 +381,7 @@ def main(argv=None) -> dict:
     router = None
     frontend = None
     watchdog = None
+    mesh = None
     try:
         if not pipeline.wait_first_promotion(
             timeout_s=max(deadline - time.time(), 1.0)
@@ -361,30 +391,75 @@ def main(argv=None) -> dict:
                 f"({budget_s:g}s) — see logs/{cfg.name}/promotions.jsonl"
             )
 
-        from marl_distributedformation_tpu.serving.fleet import (
-            fleet_from_checkpoint_dir,
-            warmup_fleet,
-        )
-
         buckets = cfg.get("pipeline_buckets") or [1, 8]
-        router, coordinator = fleet_from_checkpoint_dir(
-            pipeline.promoted_dir,
-            env_params=env_params,
-            act_dim=env_params.act_dim,
-            num_replicas=replicas,
-            buckets=tuple(int(b) for b in buckets),
-        )
-        router.start()
-        warmup_fleet(router, (env_params.obs_dim,))
-        port = cfg.get("pipeline_port")
-        if port is not None:
-            from marl_distributedformation_tpu.serving.fleet import (
-                FleetFrontend,
+        mesh_serve = bool(cfg.get("mesh_serve", False))
+        mesh = None
+        if mesh_serve:
+            # The cross-host shape (serving/mesh/, docs/mesh.md): host
+            # SUBPROCESSES serve the promoted directory behind the
+            # MetaRouter; the MeshCoordinator drives every promotion
+            # as a coordinator-barriered global commit, and the
+            # supervisor is none the wiser (duck-typed attach_fleet).
+            from marl_distributedformation_tpu.serving.mesh import (
+                spawn_local_mesh,
             )
 
-            frontend = FleetFrontend(router, port=int(port)).start()
-            report["frontend_url"] = frontend.url
-            print(f"[always] frontend: {frontend.url}", file=sys.stderr)
+            mesh_port = cfg.get("mesh_port")
+            mesh = spawn_local_mesh(
+                pipeline.promoted_dir,
+                hosts=int(cfg.get("mesh_hosts", 2)),
+                replicas_per_host=replicas,
+                buckets=tuple(int(b) for b in buckets),
+                num_agents=env_params.num_agents,
+                heartbeat_s=float(cfg.get("mesh_heartbeat_s", 0.25)),
+                lease_s=float(cfg.get("mesh_lease_s", 1.0)),
+                dead_after_s=float(cfg.get("mesh_dead_after_s", 1.0)),
+                prepare_timeout_s=float(
+                    cfg.get("mesh_prepare_timeout_s", 30.0)
+                ),
+                frontend_port=(
+                    int(mesh_port) if mesh_port is not None else None
+                ),
+                ready_timeout_s=max(deadline - time.time(), 30.0),
+            )
+            router, coordinator = mesh.router, mesh.coordinator
+            if mesh.frontend is not None:
+                report["frontend_url"] = mesh.frontend.url
+                print(
+                    f"[always] mesh frontend: {mesh.frontend.url}",
+                    file=sys.stderr,
+                )
+            print(
+                f"[always] mesh: {len(mesh.hosts)} host subprocesses, "
+                f"coordinator {coordinator.url}",
+                file=sys.stderr,
+            )
+        else:
+            from marl_distributedformation_tpu.serving.fleet import (
+                fleet_from_checkpoint_dir,
+                warmup_fleet,
+            )
+
+            router, coordinator = fleet_from_checkpoint_dir(
+                pipeline.promoted_dir,
+                env_params=env_params,
+                act_dim=env_params.act_dim,
+                num_replicas=replicas,
+                buckets=tuple(int(b) for b in buckets),
+            )
+            router.start()
+            warmup_fleet(router, (env_params.obs_dim,))
+            port = cfg.get("pipeline_port")
+            if port is not None:
+                from marl_distributedformation_tpu.serving.fleet import (
+                    FleetFrontend,
+                )
+
+                frontend = FleetFrontend(router, port=int(port)).start()
+                report["frontend_url"] = frontend.url
+                print(
+                    f"[always] frontend: {frontend.url}", file=sys.stderr
+                )
         pipeline.attach_fleet(router, coordinator)
         monitor = _monitor(cfg, router)
         if monitor is not None:
@@ -397,7 +472,10 @@ def main(argv=None) -> dict:
         # thread, so only the fleet lanes are watchdogged; the
         # background-loop mode — pipeline.run() — also gets the
         # pipeline lane via watchdog.watch_pipeline.)
-        if bool(cfg.get("watchdog", True)):
+        if bool(cfg.get("watchdog", True)) and not mesh_serve:
+            # Mesh mode has no in-process fleet lanes to watch — each
+            # host subprocess supervises its own schedulers, and host
+            # DEATH is the coordinator's lease taxonomy's job.
             from marl_distributedformation_tpu.chaos import LaneWatchdog
 
             watchdog = LaneWatchdog(
@@ -503,13 +581,22 @@ def main(argv=None) -> dict:
         report["train_alive"] = train_thread.is_alive()
         if train_error:
             report["train_error"] = train_error[0][:300]
-        compile_receipts = router.compile_counts()
+        if mesh_serve:
+            # Per-host receipts scraped over HTTP (the compiled
+            # programs live in the host subprocesses); the ledger
+            # receipt equality below only covers THIS process.
+            receipt_sets = router.host_compile_counts()
+            report["mesh_hosts"] = len(mesh.hosts)
+            report["mesh_commit_rounds"] = coordinator.commit_round
+            report["mesh_host_states"] = {
+                h["host_id"]: h["state"] for h in coordinator.hosts()
+            }
+            compile_receipts = {}
+        else:
+            compile_receipts = router.compile_counts()
+            receipt_sets = compile_receipts
         report["serving_max_compiles_per_rung"] = max(
-            (
-                c
-                for per in compile_receipts.values()
-                for c in per.values()
-            ),
+            (c for per in receipt_sets.values() for c in per.values()),
             default=0,
         )
         # Program ledger: every budget-1 compile site appears in the
@@ -556,7 +643,9 @@ def main(argv=None) -> dict:
             telemetry.stop()
         if frontend is not None:
             frontend.stop()
-        if router is not None:
+        if mesh is not None:
+            mesh.stop()  # hosts + coordinator + mesh frontend
+        elif router is not None:
             router.stop()
         pipeline.stop()
 

@@ -10,6 +10,14 @@ checkpoint-dir consistency) and reports MTTR + violations as ONE JSON
 line:
 
     python scripts/chaos_storm.py --seed 0 --faults 25
+    python scripts/chaos_storm.py --mesh --seed 0   # 2-host loopback mesh
+
+``--mesh`` points the same storm at the cross-host tier
+(serving/mesh/, docs/mesh.md): the control-plane faults arm in this
+process and one host subprocess eats a REAL ``kill -9`` mid-storm —
+the invariant suite below runs unchanged over the mesh (ROADMAP item
+1's transfer test), plus two mesh checkers (the killed host must be
+declared dead; at least one coordinator-driven global swap must land).
 
 The campaign is DETERMINISTIC from its seed: ``--print-schedule`` emits
 the armed fault schedule (a pure function of the CLI args) without
@@ -64,6 +72,17 @@ SERVE_POINTS = (
     "registry.swap",
     "scheduler.dispatch",
 )
+# The --mesh campaign's serve leg: the control-plane seams that live in
+# THIS process (coordinator + pipeline). The per-host seams live in the
+# host SUBPROCESSES — their disruption is the real `kill -9` below,
+# which no fault plane can simulate from here.
+MESH_SERVE_POINTS = (
+    "stream.poll",
+    "gate.eval",
+    "pipeline.poll",
+    "mesh.rpc",
+    "mesh.heartbeat",
+)
 
 # Hit windows per point: high-frequency seams (polls, worker loops) can
 # absorb faults deep into the campaign; rare seams (one hit per commit
@@ -79,6 +98,10 @@ WINDOWS = {
     "stream.poll": 12,
     "pipeline.poll": 12,
     "scheduler.dispatch": 12,
+    # mesh: rpc legs fire a few times per commit round, heartbeats
+    # continuously — same rare-vs-frequent split.
+    "mesh.rpc": 4,
+    "mesh.heartbeat": 12,
 }
 
 
@@ -87,17 +110,20 @@ def build_schedule(
     faults: int,
     wedge_s: float = 3.0,
     delay_s: float = 0.02,
+    point_names: Optional[Tuple[str, ...]] = None,
 ):
     """The campaign's armed faults — a pure function of the arguments
-    (the determinism the acceptance criterion pins)."""
+    (the determinism the acceptance criterion pins). ``point_names``
+    defaults to the single-host campaign's seams; the --mesh campaign
+    passes its own set."""
     from marl_distributedformation_tpu.chaos import (
         FaultSchedule,
         INJECTION_POINTS,
     )
 
-    points = {
-        p: INJECTION_POINTS[p] for p in TRAIN_POINTS + SERVE_POINTS
-    }
+    if point_names is None:
+        point_names = TRAIN_POINTS + SERVE_POINTS
+    points = {p: INJECTION_POINTS[p] for p in point_names}
     return FaultSchedule.from_seed(
         seed,
         faults=faults,
@@ -501,6 +527,305 @@ def run_campaign(
     return report
 
 
+def run_mesh_campaign(
+    seed: int = 0,
+    faults: int = 20,
+    hosts: int = 2,
+    workdir: Optional[str] = None,
+    budget_s: float = 300.0,
+    num_agents: int = 3,
+    num_formations: int = 4,
+    train_iterations: int = 16,
+    eval_formations: int = 8,
+    wedge_s: float = 2.0,
+    gate_timeout_s: float = 1.5,
+    probe_interval_s: float = 0.05,
+) -> Dict[str, Any]:
+    """The storm pointed at a loopback multi-process mesh (ROADMAP item
+    1's transfer test): the SAME invariant checkers, now with the fleet
+    spread over ``hosts`` real subprocesses, the control-plane faults
+    armed in this process, and a real ``kill -9`` of one host
+    mid-storm instead of a ``SimulatedCrash``. One JSON line out, same
+    shape as :func:`run_campaign` plus the ``mesh_*`` fields."""
+    import shutil
+    import signal
+    import tempfile
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.chaos import (
+        DISRUPTIVE_KINDS,
+        LaneWatchdog,
+        Violation,
+        check_audit_log,
+        check_budget_one,
+        check_checkpoint_dir,
+        check_no_request_lost,
+        check_step_monotonic,
+        get_fault_plane,
+        report_violations,
+    )
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.pipeline import (
+        AlwaysLearningPipeline,
+        GateConfig,
+    )
+    from marl_distributedformation_tpu.serving.mesh import spawn_local_mesh
+    from marl_distributedformation_tpu.train import TrainConfig, Trainer
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        checkpoint_path,
+        checkpoint_step,
+        latest_checkpoint,
+        restore_latest_partial,
+    )
+
+    t_start = time.perf_counter()
+    deadline = t_start + budget_s
+    workdir = Path(
+        workdir
+        if workdir is not None
+        else tempfile.mkdtemp(prefix="chaos_mesh_")
+    )
+    log_dir = workdir / "run"
+    env = EnvParams(num_agents=num_agents, max_steps=20)
+    schedule = build_schedule(
+        seed,
+        faults,
+        wedge_s=wedge_s,
+        point_names=TRAIN_POINTS + MESH_SERVE_POINTS,
+    )
+    plane = get_fault_plane()
+    plane.reset()
+    report: Dict[str, Any] = {
+        "deterministic": {
+            "chaos_seed": int(seed),
+            "chaos_faults_armed": len(schedule),
+            "schedule": schedule.record(),
+        },
+        "mesh_hosts": int(hosts),
+    }
+    violations: List[Violation] = []
+
+    # ---- phase 1: train under checkpoint-path faults -------------------
+    per_iter = num_formations * num_agents * 5
+    trainer = Trainer(
+        env,
+        ppo=PPOConfig(n_steps=5, n_epochs=2, batch_size=32),
+        config=TrainConfig(
+            num_formations=num_formations,
+            total_timesteps=train_iterations * per_iter,
+            save_freq=5,
+            fused_chunk=2,
+            name="chaos_mesh_storm",
+            log_dir=str(log_dir),
+            seed=0,
+        ),
+    )
+    plane.arm(_split(schedule, TRAIN_POINTS))
+    plane.enabled = True
+    trainer.train()  # must SURVIVE the injected write failures
+    plane.enabled = False
+
+    # ---- phase 2: crash-consistent resume ------------------------------
+    found = restore_latest_partial(log_dir, trainer._checkpoint_target())
+    report["resume_ok"] = bool(found)
+
+    # ---- phase 3: bootstrap the pipeline, then the mesh ----------------
+    gate_cfg = GateConfig(
+        scenarios=("wind",),
+        severities=(1.0,),
+        eval_formations=eval_formations,
+        clean_tolerance=10.0,
+        rung_tolerance=10.0,
+    )
+    pipeline = AlwaysLearningPipeline(
+        log_dir, env, gate_config=gate_cfg, poll_interval_s=0.05
+    )
+    if not pipeline.wait_first_promotion(
+        timeout_s=max(30.0, deadline - time.perf_counter())
+    ):
+        report["error"] = "no candidate passed the bootstrap gate"
+        report["chaos_invariant_violations"] = -1
+        return report
+    mesh = spawn_local_mesh(
+        pipeline.promoted_dir,
+        hosts=hosts,
+        buckets=(1, 8),
+        num_agents=num_agents,
+        heartbeat_s=0.2,
+        lease_s=0.8,
+        dead_after_s=0.8,
+        probe_interval_s=0.5,
+        ready_timeout_s=max(30.0, deadline - time.perf_counter()),
+    )
+    prober = None
+    killed_host = None
+    t_kill = None
+    # The pipeline lane is the only in-process lane to supervise — the
+    # hosts are separate processes whose death IS the scenario (the
+    # coordinator's lease taxonomy owns declaring it).
+    watchdog = LaneWatchdog(
+        wedge_timeout_s=1.0,
+        backoff_base_s=0.1,
+        backoff_cap_s=2.0,
+        poll_interval_s=0.1,
+    )
+    try:
+        pipeline.attach_fleet(mesh.router, mesh.coordinator)
+        pipeline.gate.config = dataclasses.replace(
+            gate_cfg, gate_timeout_s=gate_timeout_s
+        )
+        watchdog.watch_pipeline(pipeline)
+        watchdog.start()
+        prober = _Prober(
+            mesh.router, env.obs_dim, interval_s=probe_interval_s
+        ).start()
+        plane.arm(_split(schedule, MESH_SERVE_POINTS))
+        plane.enabled = True
+        pipeline.run(interval_s=0.05)
+        # Pace like the single-host storm: keep the candidate stream
+        # fed while commit-path cells are pending, and mid-storm drop
+        # the hammer — a REAL SIGKILL of one host subprocess.
+        candidate_points = ("gate.eval", "mesh.rpc")
+        synth_src = found[0] if found is not None else None
+        newest = latest_checkpoint(log_dir)
+        synth_step = checkpoint_step(newest) if newest is not None else 0
+        synth_last, synth_count = time.perf_counter(), 0
+        kill_at = time.perf_counter() + 3.0
+        # Pace until every serve-leg fault fired AND at least one
+        # coordinator-driven global swap LANDED (swap_count counts
+        # commits that served; commit_round counts attempts including
+        # aborts — an all-abort campaign must keep waiting) — or the
+        # budget ends.
+        while (
+            plane.pending(MESH_SERVE_POINTS) > 0
+            or mesh.coordinator.swap_count == 0
+        ) and time.perf_counter() < deadline:
+            time.sleep(0.1)
+            if killed_host is None and time.perf_counter() >= kill_at:
+                t_kill = time.perf_counter()
+                killed_host = mesh.kill_host(0, sig=signal.SIGKILL)
+            if (
+                synth_src is not None
+                and plane.pending(candidate_points) > 0
+                and time.perf_counter() - synth_last > 1.0
+                and synth_count < 24
+            ):
+                synth_step += per_iter
+                dst = checkpoint_path(log_dir, synth_step)
+                tmp = dst.with_name(f".{dst.name}.tmp")
+                shutil.copyfile(synth_src, tmp)
+                tmp.replace(dst)
+                pipeline.stream.nudge()
+                synth_last = time.perf_counter()
+                synth_count += 1
+        if killed_host is None:
+            # Every fault fired before the timer — the kill is still
+            # owed (it IS the campaign's headline disruption).
+            t_kill = time.perf_counter()
+            killed_host = mesh.kill_host(0, sig=signal.SIGKILL)
+        time.sleep(max(2.0, wedge_s))
+        plane.enabled = False
+        pipeline.stop()
+        watchdog.stop()
+        prober.stop()
+    finally:
+        plane.enabled = False
+        if prober is not None:
+            prober.stop()
+        watchdog.stop()
+        pipeline.stop()
+        receipts = mesh.router.host_compile_counts()
+        mesh_snapshot = mesh.router.snapshot()
+        mesh_swaps_landed = mesh.coordinator.swap_count
+        host_states = {
+            h["host_id"]: h["state"] for h in mesh.coordinator.hosts()
+        }
+        mesh.stop()
+
+    # ---- phase 4: invariants (the PR-12 suite, unchanged) --------------
+    fired = plane.fired_record()
+    disruptions = [
+        f["t"]
+        for f in plane.fired
+        if f["kind"] in DISRUPTIVE_KINDS and f["point"] in MESH_SERVE_POINTS
+    ]
+    if t_kill is not None:
+        disruptions.append(t_kill)  # the kill -9 IS a disruption
+    mttr = prober.mttr_samples(disruptions)
+    violations += check_step_monotonic(
+        prober.steps,
+        rollback_to_steps=[r["to_step"] for r in pipeline.rollbacks],
+    )
+    violations += check_no_request_lost(prober.outcomes)
+    compiles = {
+        "gate_matrix": (
+            pipeline.gate.program.compile_count
+            if pipeline.gate.program is not None
+            else 0
+        ),
+    }
+    for host_id, per_rung in receipts.items():
+        for rung, count in per_rung.items():
+            compiles[f"{host_id}_{rung}"] = int(count)
+    violations += check_budget_one(compiles)
+    violations += check_audit_log(log_dir / "promotions.jsonl")
+    violations += check_checkpoint_dir(log_dir)
+    violations += check_checkpoint_dir(pipeline.promoted_dir)
+    if disruptions and not mttr:
+        violations.append(
+            Violation(
+                "recovery",
+                f"{len(disruptions)} disruption(s) (incl. the host "
+                "kill) but no probe ever succeeded afterwards — the "
+                "mesh never recovered",
+            )
+        )
+    if killed_host is not None and host_states.get(killed_host) != "dead":
+        violations.append(
+            Violation(
+                "gossip",
+                f"killed host {killed_host} never declared dead "
+                f"(state: {host_states.get(killed_host)!r}) — the "
+                "lease/suspect/dead taxonomy missed a real SIGKILL",
+            )
+        )
+    if mesh_swaps_landed == 0:
+        violations.append(
+            Violation(
+                "global_commit",
+                "no coordinator-driven global swap LANDED during the "
+                "campaign (aborted rounds don't count) — the "
+                "monotonicity witness never crossed a cross-host "
+                "commit, so the acceptance criterion was not exercised",
+            )
+        )
+    report["chaos_violations"] = report_violations(violations, plane)
+    report["chaos_invariant_violations"] = len(violations)
+    report["chaos_faults_fired"] = len(fired)
+    report["chaos_faults_unfired"] = plane.pending()
+    if mttr:
+        report["chaos_mttr_s"] = round(max(mttr), 3)
+        report["chaos_mttr_p50_s"] = round(sorted(mttr)[len(mttr) // 2], 3)
+    report["chaos_disruptions"] = len(disruptions)
+    report["probes_total"] = len(prober.outcomes)
+    report["probes_ok"] = sum(1 for o in prober.outcomes if o["ok"])
+    report["promotions"] = len(pipeline.promotions)
+    report["rejections"] = len(pipeline.rejections)
+    report["pipeline_restarts"] = watchdog.restarts_total()
+    report["mesh_host_killed"] = killed_host
+    report["mesh_host_states"] = host_states
+    report["mesh_commit_rounds"] = int(
+        mesh_snapshot.get("mesh_commit_rounds", 0)
+    )
+    report["mesh_global_swaps"] = int(mesh_swaps_landed)
+    report["mesh_failed_over_total"] = int(
+        mesh_snapshot.get("mesh_failed_over_total", 0)
+    )
+    report["mesh_final_step"] = int(mesh_snapshot.get("mesh_step", -1))
+    report["campaign_seconds"] = round(time.perf_counter() - t_start, 2)
+    return report
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
@@ -508,26 +833,61 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--budget-s", type=float, default=300.0)
     ap.add_argument("--workdir", default=None)
     ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="point the storm at a loopback multi-process mesh "
+        "(serving/mesh): control-plane faults in this process plus a "
+        "real kill -9 of one host subprocess; the PR-12 invariant "
+        "suite runs unchanged",
+    )
+    ap.add_argument(
+        "--hosts", type=int, default=2,
+        help="with --mesh: host subprocesses to spawn",
+    )
+    ap.add_argument(
         "--print-schedule",
         action="store_true",
         help="emit the armed fault schedule (deterministic from the "
         "seed) and exit without running anything",
     )
     args = ap.parse_args(argv)
+    mesh_faults = min(args.faults, 20) if args.mesh else args.faults
+    if args.mesh and mesh_faults < args.faults:
+        print(
+            f"[storm] --mesh caps --faults at 20 (requested "
+            f"{args.faults}): the mesh serve leg has fewer armable "
+            "cells and paces until every one fires",
+            file=sys.stderr,
+        )
     if args.print_schedule:
-        schedule = build_schedule(args.seed, args.faults)
+        schedule = build_schedule(
+            args.seed,
+            mesh_faults,
+            point_names=(
+                TRAIN_POINTS + MESH_SERVE_POINTS if args.mesh else None
+            ),
+        )
         print(json.dumps({
             "chaos_seed": args.seed,
             "chaos_faults_armed": len(schedule),
             "schedule": schedule.record(),
         }))
         return 0
-    report = run_campaign(
-        seed=args.seed,
-        faults=args.faults,
-        workdir=args.workdir,
-        budget_s=args.budget_s,
-    )
+    if args.mesh:
+        report = run_mesh_campaign(
+            seed=args.seed,
+            faults=mesh_faults,
+            hosts=args.hosts,
+            workdir=args.workdir,
+            budget_s=args.budget_s,
+        )
+    else:
+        report = run_campaign(
+            seed=args.seed,
+            faults=args.faults,
+            workdir=args.workdir,
+            budget_s=args.budget_s,
+        )
     print(json.dumps(report))
     return 0 if report.get("chaos_invariant_violations") == 0 else 1
 

@@ -484,6 +484,95 @@ def census_diff(
     return problems
 
 
+def _mesh_problems(rec: dict) -> list[str]:
+    """Structural validation of the mesh-tier fields (bench phase 14),
+    whenever present: throughput a finite positive number; global-swap
+    latency percentiles finite, positive, and ordered (p50 <= p95);
+    ``mesh_failover_lost_requests`` EXACTLY 0 (losing an accepted
+    request across a host kill is a broken failover story, not a slow
+    one); and every per-host compile receipt at most 1 (the budget-1
+    invariant restated per host). ``"skipped"`` sentinels are honored
+    as structurally absent."""
+    problems = []
+    rate = _present(rec, "mesh_req_per_sec")
+    if rate is not None:
+        try:
+            v = float(rate)
+            if not math.isfinite(v) or v <= 0.0:
+                problems.append(
+                    f"mesh_req_per_sec={rate!r} (need a finite number "
+                    "> 0 — a zero-throughput mesh measured nothing)"
+                )
+        except (TypeError, ValueError):
+            problems.append(f"mesh_req_per_sec is not a number: {rate!r}")
+    p50 = _present(rec, "mesh_global_swap_latency_s_p50")
+    p95 = _present(rec, "mesh_global_swap_latency_s_p95")
+    for name, value in (
+        ("mesh_global_swap_latency_s_p50", p50),
+        ("mesh_global_swap_latency_s_p95", p95),
+    ):
+        if value is None:
+            continue
+        try:
+            v = float(value)
+            if not math.isfinite(v) or v <= 0.0:
+                problems.append(
+                    f"{name}={value!r} (need a finite number > 0: a "
+                    "global swap crosses at least one RPC round trip)"
+                )
+        except (TypeError, ValueError):
+            problems.append(f"{name} is not a number: {value!r}")
+    if p50 is not None and p95 is not None:
+        try:
+            if float(p50) > float(p95):
+                problems.append(
+                    f"mesh swap p50 {p50!r} > p95 {p95!r} — percentile "
+                    "order violated"
+                )
+        except (TypeError, ValueError):
+            pass  # already reported above
+    lost = _present(rec, "mesh_failover_lost_requests")
+    if lost is not None:
+        try:
+            if int(lost) != 0:
+                problems.append(
+                    f"mesh_failover_lost_requests={lost!r} — an "
+                    "accepted request lost across a host kill is a "
+                    "broken no-request-lost invariant, not a slow one"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"mesh_failover_lost_requests is not an int: {lost!r}"
+            )
+    step_violations = _present(rec, "mesh_step_violations")
+    if step_violations is not None:
+        try:
+            if int(step_violations) != 0:
+                problems.append(
+                    f"mesh_step_violations={step_violations!r} — "
+                    "model_step went backward in response completion "
+                    "order across hosts; the global barrier is broken"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"mesh_step_violations is not an int: {step_violations!r}"
+            )
+    receipts = _present(rec, "mesh_host_compile_receipts_max")
+    if receipts is not None:
+        try:
+            if float(receipts) > 1.0:
+                problems.append(
+                    f"mesh_host_compile_receipts_max={receipts!r} "
+                    "breaches the per-host budget-1 receipt"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                "mesh_host_compile_receipts_max is not a number: "
+                f"{receipts!r}"
+            )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -503,6 +592,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_adversarial_problems(rec))
     problems.extend(_chaos_problems(rec))
     problems.extend(_ledger_problems(rec))
+    problems.extend(_mesh_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(

@@ -384,7 +384,11 @@ def test_gate_rejection_feeds_trainer_schedule_end_to_end(tmp_path):
     events = [r["event"] for r in records]
     assert events == ["rejected", "curriculum_updated"]
     rejected = records[0]
-    assert rejected["schema"] == 3
+    from marl_distributedformation_tpu.pipeline.promote import (
+        PROMOTIONS_SCHEMA,
+    )
+
+    assert rejected["schema"] == PROMOTIONS_SCHEMA
     assert rejected["falsifiers"][0]["scenario"] == "wind"
     assert rejected["falsifiers"][0]["params"]["wind"][0] > 0.0
     updated = records[1]

@@ -378,6 +378,55 @@ def test_check_bench_record_gates():
         },
         [], [],
     ) == []
+    # Mesh-tier fields (bench phase 14), validated whenever present:
+    # throughput finite > 0, swap latency percentiles finite > 0 and
+    # ordered, failover-lost EXACTLY 0, per-host compile receipts at
+    # most 1, "skipped" sentinels honored.
+    mesh_ok = {
+        **clean,
+        "mesh_req_per_sec": 412.0,
+        "mesh_global_swap_latency_s_p50": 0.03,
+        "mesh_global_swap_latency_s_p95": 0.09,
+        "mesh_failover_lost_requests": 0,
+        "mesh_host_compile_receipts_max": 1.0,
+    }
+    assert check(mesh_ok, [], []) == []
+    assert check({**mesh_ok, "mesh_req_per_sec": 0.0}, [], [])
+    assert check({**mesh_ok, "mesh_req_per_sec": "fast"}, [], [])
+    assert check(
+        {**mesh_ok, "mesh_global_swap_latency_s_p50": 0.0}, [], []
+    )
+    assert check(
+        {**mesh_ok, "mesh_global_swap_latency_s_p95": float("inf")},
+        [], [],
+    )
+    assert check(  # percentile order violated
+        {
+            **mesh_ok,
+            "mesh_global_swap_latency_s_p50": 0.2,
+            "mesh_global_swap_latency_s_p95": 0.1,
+        },
+        [], [],
+    )
+    assert check({**mesh_ok, "mesh_failover_lost_requests": 1}, [], [])
+    assert check(
+        {**mesh_ok, "mesh_failover_lost_requests": "none"}, [], []
+    )
+    assert check({**mesh_ok, "mesh_step_violations": 0}, [], []) == []
+    assert check({**mesh_ok, "mesh_step_violations": 2}, [], [])
+    assert check(
+        {**mesh_ok, "mesh_host_compile_receipts_max": 2.0}, [], []
+    )
+    assert check(
+        {
+            **clean,
+            "mesh_req_per_sec": "skipped",
+            "mesh_global_swap_latency_s_p50": "skipped",
+            "mesh_global_swap_latency_s_p95": "skipped",
+            "mesh_failover_lost_requests": "skipped",
+        },
+        [], [],
+    ) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

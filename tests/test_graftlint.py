@@ -1113,6 +1113,72 @@ FIXTURES = [
             return carry, stacked
         """,
     ),
+    (
+        # Rule 21: a mesh RPC round trip under trace fires once per
+        # COMPILE and wedges the tracer on a dead peer. The good twin
+        # makes the coordinator call at the dispatch seam around the
+        # jitted call.
+        "rpc-in-traced-scope",
+        """
+        import jax
+        from marl_distributedformation_tpu.serving.mesh.rpc import rpc_call
+
+        @jax.jit
+        def step(x):
+            rpc_call("http://127.0.0.1:9", "mesh.heartbeat", {})
+            return x * 2
+        """,
+        """
+        import jax
+        from marl_distributedformation_tpu.serving.mesh.rpc import rpc_call
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def dispatch(x):
+            out = step(x)
+            rpc_call("http://127.0.0.1:9", "mesh.heartbeat", {})
+            return out
+        """,
+    ),
+    (
+        # Same hazard one hop away inside a scan body, through a
+        # mesh-receiver chain and a raw socket-module call; the good
+        # twin's helper runs at the host seam, and an unrelated
+        # ``registry.register(...)`` receiver stays clean.
+        "rpc-in-traced-scope",
+        """
+        import socket
+        from jax import lax
+
+        def phone_home(coordinator):
+            coordinator.global_reload("ckpt")
+            socket.create_connection(("127.0.0.1", 9))
+
+        def train(xs, coordinator):
+            def body(carry, x):
+                phone_home(coordinator)
+                return carry + x, x
+            return lax.scan(body, 0.0, xs)
+        """,
+        """
+        import socket
+        from jax import lax
+
+        def phone_home(coordinator):
+            coordinator.global_reload("ckpt")
+            socket.create_connection(("127.0.0.1", 9))
+
+        def train(xs, coordinator, registry):
+            def body(carry, x):
+                registry.register(x)  # not mesh-like: stays clean
+                return carry + x, x
+            carry, stacked = lax.scan(body, 0.0, xs)
+            phone_home(coordinator)  # the dispatch seam: host-side
+            return carry, stacked
+        """,
+    ),
 ]
 
 
@@ -1158,6 +1224,11 @@ def test_package_scan_covers_serving():
     assert len(served) >= 6, f"serving/ missing from the lint scan: {files}"
     fleet = [f for f in served if "fleet" in f.parts]
     assert len(fleet) >= 6, f"serving/fleet/ missing from the scan: {served}"
+    mesh = [f for f in served if "mesh" in f.parts]
+    assert len(mesh) >= 6, (
+        f"serving/mesh/ missing from the scan (rule 21's subject must "
+        f"itself stay pinned at 0): {served}"
+    )
 
 
 def test_package_scan_covers_train_modules():

@@ -261,11 +261,11 @@ def test_promotion_log_schema(tmp_path):
 
 def test_promotion_log_reader_accepts_old_schemas_rejects_unknown(tmp_path):
     """Schema bumps 1 -> 2 (trace_id + spans) -> 3 (adversarial
-    falsifiers): old logs stay readable — the reader backfills the newer
-    fields as None so schema-3 consumers need no per-line branching —
-    and an UNKNOWN (future) schema fails loudly instead of being
-    silently misread."""
-    assert PROMOTIONS_SCHEMA == 3
+    falsifiers) -> 4 (mesh host_count/commit_round): old logs stay
+    readable — the reader backfills the newer fields as None so
+    schema-4 consumers need no per-line branching — and an UNKNOWN
+    (future) schema fails loudly instead of being silently misread."""
+    assert PROMOTIONS_SCHEMA == 4
     path = tmp_path / "promotions.jsonl"
     with open(path, "w") as f:
         f.write(json.dumps({  # a verbatim PR-7-era line
@@ -288,14 +288,24 @@ def test_promotion_log_reader_accepts_old_schemas_rejects_unknown(tmp_path):
     assert obs_era["trace_id"] == "abc123"
     assert obs_era["spans"] == {"gate_eval_s": 0.5}
     assert obs_era["falsifiers"] is None
-    assert new["schema"] == 3
+    assert new["schema"] == PROMOTIONS_SCHEMA
     assert new["trace_id"] == "def456"
     assert new["falsifiers"] == [{"scenario": "wind", "severity": 0.4}]
-    # A schema-3 line written with the adversarial rung OFF has no
+    # Every pre-4 line (and a schema-4 rejection, which never swaps)
+    # lacks the mesh commit attribution — backfilled None everywhere.
+    assert oldest["host_count"] is None and oldest["commit_round"] is None
+    assert obs_era["host_count"] is None
+    assert new["host_count"] is None and new["commit_round"] is None
+    # A schema-4 line written with the adversarial rung OFF has no
     # falsifiers key either — the reader backfills None there too, so
     # consumers never branch per line (or KeyError) on gate config.
-    PromotionLog(path).append("promoted", step=40, trace_id="ghi789")
+    PromotionLog(path).append(
+        "promoted", step=40, trace_id="ghi789",
+        host_count=2, commit_round=7,
+    )
     assert PromotionLog.read(path)[-1]["falsifiers"] is None
+    assert PromotionLog.read(path)[-1]["host_count"] == 2
+    assert PromotionLog.read(path)[-1]["commit_round"] == 7
     with open(path, "a") as f:
         f.write(json.dumps({"schema": 99, "event": "promoted"}) + "\n")
     with pytest.raises(ValueError, match="schema 99"):
