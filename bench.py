@@ -83,6 +83,15 @@ Measures, inside one process and one JSON line:
   monotonicity must hold across hosts (``mesh_step_violations`` == 0),
   and every surviving host's compile receipts stay at 1
   (``mesh_host_compile_receipts_max``).
+- ``health_overhead_pct`` / ``recovery_mttr_s`` /
+  ``train_divergence_events``: the self-healing train lane
+  (train/recovery.py, docs/recovery.md) — the fused loop re-timed with
+  the in-program health word + skip guard ON vs OFF (interleaved,
+  phase-11 methodology; the bar is <= 5%), plus a seeded NaN carry
+  bomb through a live fused run with the recovery ladder armed:
+  detection-at-drain -> rollback wall clock from recovery.jsonl, and
+  the ladder's sustained-breach count (>= 1 or the detector is
+  broken).
 
 Phases skipped via
   ``BENCH_SKIP_*`` env vars record the explicit ``"skipped"`` sentinel
@@ -2090,6 +2099,211 @@ def main() -> None:
                 notes.append(f"mesh phase failed: {e!r}"[:200])
         else:
             notes.append("mesh phase skipped: deadline")
+
+        # --- Phase 15: train-lane recovery (train/recovery.py,
+        # docs/recovery.md). Three headline fields:
+        # health_overhead_pct — the phase-11 interleaved fused loop
+        # (dispatch N+1, drain N through the REAL Trainer._drain_chunk)
+        # with the in-program health word + skip guard ON vs OFF (two
+        # trainers, one compiled program each; best-of-N passes
+        # alternate modes so container load drift books to neither);
+        # recovery_mttr_s — a seeded NaN carry bomb through a live
+        # fused run with the ladder armed, detection-at-drain ->
+        # rollback wall from recovery.jsonl; train_divergence_events —
+        # the ladder's sustained-breach count for that run (MUST be
+        # >= 1: a bomb that never registers is a broken detector, not
+        # a fast one).
+        recovery_fields = (
+            "health_overhead_pct",
+            "recovery_mttr_s",
+            "train_divergence_events",
+        )
+        if os.environ.get("BENCH_SKIP_TRAIN") == "1":
+            _mark_skipped(result, "recovery", recovery_fields)
+        elif time.time() < deadline - 30:
+            try:
+                from marl_distributedformation_tpu.algo import PPOConfig
+                from marl_distributedformation_tpu.chaos import (
+                    FaultSchedule,
+                    FaultSpec,
+                    get_fault_plane,
+                )
+                from marl_distributedformation_tpu.train import (
+                    TrainConfig,
+                    Trainer,
+                    read_recovery_log,
+                )
+                from marl_distributedformation_tpu.utils import MetricsLogger
+                from marl_distributedformation_tpu.utils.config import (
+                    PRESETS,
+                )
+                from marl_distributedformation_tpu.utils.profiling import (
+                    Throughput,
+                )
+
+                r_chunk = _env_int("BENCH_RECOVERY_CHUNK", 8)
+                train_m = _env_int("BENCH_TRAIN_M", M if on_accel else 256)
+
+                def make_recovery_trainer(name: str, health: bool):
+                    return Trainer(
+                        EnvParams(num_agents=N),
+                        ppo=PPOConfig(
+                            batch_size=PRESETS["tpu"]["batch_size"]
+                        ),
+                        config=TrainConfig(
+                            num_formations=train_m, checkpoint=False,
+                            use_wandb=False, name=name,
+                            log_dir=f"/tmp/{name}",
+                            fused_chunk=r_chunk, health=health,
+                        ),
+                    )
+
+                trainers = {
+                    "on": make_recovery_trainer("bench_health_on", True),
+                    "off": make_recovery_trainer("bench_health_off", False),
+                }
+                logger = MetricsLogger(
+                    "/tmp/bench_health_on", run_name="bench_health"
+                )
+                meter = Throughput()
+                for tr in trainers.values():  # warm twice (phase 5/11)
+                    for _ in range(2):
+                        stacked = tr.run_chunk()
+                        float(stacked["loss"][-1])
+                        if time.time() > deadline:
+                            break
+
+                def timed_pass(tr) -> float:
+                    dispatches, iteration, pend = 0, 0, None
+                    t0 = time.perf_counter()
+                    while True:
+                        steps_before = tr.num_timesteps
+                        stacked = tr.run_chunk()
+                        dispatches += 1
+                        if pend is not None:
+                            tr._drain_chunk(logger, meter, *pend)
+                        pend = (stacked, iteration, steps_before, None)
+                        iteration += r_chunk
+                        if (
+                            time.perf_counter() - t0 >= MIN_TIMED_S / 2
+                            or time.time() > deadline
+                            or dispatches * r_chunk >= 128
+                        ):
+                            break
+                    tr._drain_chunk(logger, meter, *pend)
+                    elapsed = time.perf_counter() - t0
+                    n_steps = tr.ppo.n_steps
+                    return (
+                        n_steps * train_m * dispatches * r_chunk / elapsed
+                    )
+
+                passes = _env_int("BENCH_RECOVERY_PASSES", 2)
+                rates = {"on": 0.0, "off": 0.0}
+                expired = False
+                for _ in range(max(1, passes)):
+                    for mode in ("on", "off"):
+                        rates[mode] = max(
+                            rates[mode], timed_pass(trainers[mode])
+                        )
+                        if time.time() > deadline:
+                            expired = True
+                            break
+                    if expired:
+                        break
+                logger.close()
+                if rates["on"] > 0.0 and rates["off"] > 0.0:
+                    overhead = (
+                        100.0 * (rates["off"] - rates["on"]) / rates["off"]
+                    )
+                    result["health_overhead_pct"] = round(overhead, 2)
+                    result["health_fused_rate_on"] = round(rates["on"], 1)
+                    result["health_fused_rate_off"] = round(
+                        rates["off"], 1
+                    )
+                    print(
+                        "[bench] health word (fused-scan loop, chunk="
+                        f"{r_chunk}): {rates['on']:,.0f} "
+                        f"formation-steps/s guarded vs {rates['off']:,.0f}"
+                        f" unguarded ({overhead:+.1f}%)",
+                        file=sys.stderr,
+                    )
+                else:
+                    notes.append(
+                        "health overhead unmeasured: deadline before "
+                        "both modes ran"
+                    )
+                # The recovery drill: one seeded NaN carry bomb through
+                # a SMALL fused run with the full ladder + retention
+                # ring armed; MTTR is the detection->restored wall the
+                # ladder logged. Small shapes — the restore cost under
+                # measurement is checkpoint IO + re-placement, not
+                # model math.
+                if time.time() < deadline - 20:
+                    import tempfile
+                    from pathlib import Path
+
+                    drill_dir = tempfile.mkdtemp(prefix="bench_recovery_")
+                    drill_m, drill_chunk = 8, 2
+                    per_iter = 5 * drill_m * N
+                    drill = Trainer(
+                        EnvParams(num_agents=N),
+                        ppo=PPOConfig(
+                            n_steps=5, n_epochs=2, batch_size=64
+                        ),
+                        config=TrainConfig(
+                            num_formations=drill_m,
+                            total_timesteps=16 * per_iter,
+                            save_freq=5, fused_chunk=drill_chunk,
+                            name="bench_recovery", log_dir=drill_dir,
+                            seed=_env_int("BENCH_CHAOS_SEED", 0),
+                            health=True, recovery=True,
+                            recovery_breach_iters=2, keep_last_n=4,
+                        ),
+                    )
+                    plane = get_fault_plane()
+                    was_enabled = plane.enabled
+                    # Fresh counters: phase 12's campaign already drove
+                    # a Trainer with the plane ENABLED, so the
+                    # train-lane hit counters are far past at_hit=4 —
+                    # without a reset the bomb would never fire and the
+                    # drill would record a broken detector.
+                    plane.reset()
+                    plane.arm(FaultSchedule([
+                        FaultSpec("train.carry_poison", "raise", at_hit=4)
+                    ]))
+                    plane.enabled = True
+                    try:
+                        drill.train()
+                    finally:
+                        plane.enabled = was_enabled
+                        plane.disarm()
+                    mttr = [
+                        float(e["mttr_s"])
+                        for e in read_recovery_log(
+                            Path(drill_dir) / "recovery.jsonl"
+                        )
+                        if e["event"] == "rollback"
+                    ]
+                    ladder = drill.recovery_ladder
+                    if mttr:
+                        result["recovery_mttr_s"] = round(max(mttr), 4)
+                    result["train_divergence_events"] = (
+                        ladder.breaches if ladder is not None else 0
+                    )
+                    print(
+                        "[bench] recovery drill: "
+                        f"{ladder.recoveries} rollback(s), MTTR "
+                        f"{result.get('recovery_mttr_s', 'n/a')}s, "
+                        f"{ladder.skipped_total} skipped update(s), "
+                        f"halted={drill.halted}",
+                        file=sys.stderr,
+                    )
+                else:
+                    notes.append("recovery drill skipped: deadline")
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                notes.append(f"recovery phase failed: {e!r}"[:200])
+        else:
+            notes.append("recovery phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

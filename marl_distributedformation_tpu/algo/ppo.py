@@ -318,6 +318,11 @@ def ppo_update(
         (_, metrics), grads = grad_fn(
             ts.params, ts.apply_fn, mb, config, ent_coef
         )
+        # Raw (pre-clip) global gradient norm: the divergence diagnostic
+        # the train lane's health word bounds (train/recovery.py) — the
+        # optimizer chain clips at max_grad_norm, so the clipped norm
+        # would saturate at 0.5 and hide every explosion.
+        metrics["grad_norm"] = optax.global_norm(grads)
         if ent_decay:
             metrics["ent_coef"] = ent_coef
         ts = ts.apply_gradients(grads=grads)

@@ -35,6 +35,9 @@ from marl_distributedformation_tpu.analysis.rules.ledger_scope import (
 from marl_distributedformation_tpu.analysis.rules.metrics_scope import (
     MetricsInTracedScope,
 )
+from marl_distributedformation_tpu.analysis.rules.nonfinite_probe import (
+    HostNonfiniteProbeInDispatchLoop,
+)
 from marl_distributedformation_tpu.analysis.rules.numpy_use import NumpyInJit
 from marl_distributedformation_tpu.analysis.rules.printing import PrintInJit
 from marl_distributedformation_tpu.analysis.rules.prng import PrngKeyReuse
@@ -79,6 +82,7 @@ RULES = (
     FaultPointInTracedScope(),
     LedgerRecordInTracedScope(),
     RpcInTracedScope(),
+    HostNonfiniteProbeInDispatchLoop(),
 )
 
 

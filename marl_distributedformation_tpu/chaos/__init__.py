@@ -21,7 +21,10 @@ from marl_distributedformation_tpu.chaos.invariants import (
     check_audit_log,
     check_budget_one,
     check_checkpoint_dir,
+    check_final_params_finite,
+    check_finite_checkpoints,
     check_no_request_lost,
+    check_recovery_log,
     check_step_monotonic,
     report_violations,
 )
@@ -61,7 +64,10 @@ __all__ = [
     "check_audit_log",
     "check_budget_one",
     "check_checkpoint_dir",
+    "check_final_params_finite",
+    "check_finite_checkpoints",
     "check_no_request_lost",
+    "check_recovery_log",
     "check_step_monotonic",
     "configure_chaos",
     "fault_point",

@@ -125,7 +125,7 @@ def check_budget_one(compiles: Dict[str, int]) -> List[Violation]:
 _AUDIT_EVENTS = frozenset({
     "promoted", "rejected", "rolled_back", "rollback_failed",
     "promotion_deferred", "promotion_superseded", "curriculum_updated",
-    "curriculum_update_failed",
+    "curriculum_update_failed", "candidate_vanished",
 })
 
 
@@ -233,6 +233,154 @@ def check_checkpoint_dir(log_dir: str | Path) -> List[Violation]:
                     "checkpoint_crash_consistency",
                     f"discoverable checkpoint {p.name} unreadable: {e!r}",
                     {"path": str(p)},
+                )
+            )
+    return violations
+
+
+def check_finite_checkpoints(log_dir: str | Path) -> List[Violation]:
+    """Train-lane invariant (docs/recovery.md): no DISCOVERABLE
+    checkpoint may carry non-finite float leaves — the write gate
+    (utils/checkpoint.py) must have skipped every poisoned snapshot
+    before it reached a ``rl_model_*`` name. Corrupt files are the
+    crash-consistency checker's business; this one restores each valid
+    file and walks its floats."""
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        CorruptCheckpointError,
+        msgpack_restore_file,
+        nonfinite_leaf,
+    )
+
+    violations: List[Violation] = []
+    log_dir = Path(log_dir)
+    if not log_dir.is_dir():
+        return violations
+    for p in sorted(log_dir.iterdir()):
+        if p.suffix != ".msgpack" or p.name.startswith("."):
+            continue
+        try:
+            tree = msgpack_restore_file(p, quarantine=False)
+        except (CorruptCheckpointError, OSError):
+            continue  # check_checkpoint_dir owns damage
+        bad = nonfinite_leaf(tree)
+        if bad is not None:
+            violations.append(
+                Violation(
+                    "nonfinite_checkpoint",
+                    f"discoverable checkpoint {p.name} carries "
+                    f"non-finite values at {bad} — a diverged state "
+                    "became visible to discovery (the write gate "
+                    "failed)",
+                    {"path": str(p), "leaf": bad},
+                )
+            )
+    return violations
+
+
+def check_final_params_finite(params: Any) -> List[Violation]:
+    """The run must END on finite params, whatever the fault schedule
+    did mid-flight — the recovery ladder's terminal guarantee."""
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        nonfinite_leaf,
+    )
+
+    bad = nonfinite_leaf(params)
+    if bad is None:
+        return []
+    return [
+        Violation(
+            "finite_final_params",
+            f"the run terminated with non-finite params at {bad} — the "
+            "recovery ladder failed to restore a last-good state",
+            {"leaf": bad},
+        )
+    ]
+
+
+def check_recovery_log(
+    path: str | Path,
+    max_rollbacks: Optional[int] = None,
+    mttr_bound_s: Optional[float] = None,
+) -> List[Violation]:
+    """``recovery.jsonl`` must read back as a consistent ladder history:
+    schema-valid lines (train.recovery.read_recovery_log), rollback
+    counters strictly ascending, every MTTR finite and positive (and
+    under ``mttr_bound_s`` when given — recovery must be BOUNDED, not
+    just eventual), a ``halt`` only as the final event, and no more
+    rollbacks than the configured budget."""
+    import math
+
+    from marl_distributedformation_tpu.train.recovery import (
+        read_recovery_log,
+    )
+
+    violations: List[Violation] = []
+    try:
+        records = read_recovery_log(path)
+    except ValueError as e:
+        return [
+            Violation(
+                "recovery_log", f"recovery.jsonl invalid: {e}",
+                {"path": str(path)},
+            )
+        ]
+    last_recoveries = 0
+    for i, rec in enumerate(records):
+        event = rec.get("event")
+        if event == "rollback":
+            n = int(rec["recoveries"])
+            if n != last_recoveries + 1:
+                violations.append(
+                    Violation(
+                        "recovery_log",
+                        f"line {i}: rollback counter jumped "
+                        f"{last_recoveries} -> {n} (must ascend by 1)",
+                        {"line": i},
+                    )
+                )
+            last_recoveries = n
+            if max_rollbacks is not None and n > max_rollbacks:
+                violations.append(
+                    Violation(
+                        "recovery_log",
+                        f"line {i}: {n} rollbacks exceed the configured "
+                        f"budget of {max_rollbacks}",
+                        {"line": i},
+                    )
+                )
+            mttr = rec["mttr_s"]
+            # Already-parsed JSON numbers: no float() pull (rule 22's
+            # probe-over-extraction pattern is for device values).
+            if not (
+                isinstance(mttr, (int, float))
+                and math.isfinite(mttr)
+                and mttr > 0.0
+            ):
+                violations.append(
+                    Violation(
+                        "recovery_mttr",
+                        f"line {i}: rollback MTTR {mttr!r} is not a "
+                        "finite number > 0",
+                        {"line": i},
+                    )
+                )
+            elif mttr_bound_s is not None and float(mttr) > mttr_bound_s:
+                violations.append(
+                    Violation(
+                        "recovery_mttr",
+                        f"line {i}: rollback MTTR {float(mttr):.3f}s "
+                        f"exceeds the {mttr_bound_s}s bound — recovery "
+                        "must be bounded, not merely eventual",
+                        {"line": i},
+                    )
+                )
+        elif event == "halt" and i != len(records) - 1:
+            violations.append(
+                Violation(
+                    "recovery_log",
+                    f"line {i}: 'halt' is terminal but "
+                    f"{len(records) - 1 - i} event(s) follow it",
+                    {"line": i},
                 )
             )
     return violations

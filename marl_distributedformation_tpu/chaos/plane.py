@@ -75,6 +75,24 @@ INJECTION_POINTS: Dict[str, Tuple[str, ...]] = {
     # thread: only a stall makes sense — an exception here would kill
     # the training loop, which is the writer's surfacing contract).
     "ckpt_writer.submit": ("delay",),
+    # train/trainer.py dispatch boundary — the train lane's divergence
+    # seams (train/recovery.py, docs/recovery.md). A 'raise' armed here
+    # is CAUGHT by the seam and interpreted as state corruption: the
+    # deterministic stand-in for organic divergence the in-program
+    # health word + recovery ladder must absorb.
+    #   carry_poison: NaN bomb into the live params (loss goes NaN,
+    #     every later iteration is flagged until the ladder rolls back)
+    "train.carry_poison": ("raise", "delay"),
+    #   grad_bomb: a FINITE 1e18 scale on the params — loss/gradients
+    #   explode without NaN, exercising the bounded-grad-norm and
+    #   param-drift checks (and the finite-but-poisoned-checkpoint
+    #   quarantine walk) rather than the finiteness ones.
+    "train.grad_bomb": ("raise",),
+    #   snapshot: checkpoint-time state corruption — poisons the
+    #   snapshot COPY handed to the writer (never the live carry); the
+    #   non-finite write gate (utils/checkpoint.py) must keep it
+    #   invisible to discovery.
+    "train.snapshot": ("raise", "delay"),
     # pipeline/stream.CheckpointStream.poll.
     "stream.poll": ("raise", "delay"),
     # pipeline/gate.PromotionGate eval body (runs on the gate's thread,

@@ -71,6 +71,7 @@ from marl_distributedformation_tpu.obs.sentinel import (  # noqa: F401
     default_watches,
     ledger_watches,
     load_bench_record,
+    recovery_watches,
 )
 from marl_distributedformation_tpu.obs.tracer import (  # noqa: F401
     TRACE_HEADER,
@@ -107,6 +108,7 @@ __all__ = [
     "get_registry",
     "get_tracer",
     "ledger_watches",
+    "recovery_watches",
     "load_bench_record",
     "load_census",
     "new_trace_id",

@@ -148,6 +148,28 @@ def ledger_watches(tolerance: float = 0.5) -> Tuple[Watch, ...]:
     )
 
 
+def recovery_watches(tolerance: float = 1.0) -> Tuple[Watch, ...]:
+    """Train-lane recovery guards (train/recovery.py) against the
+    committed bench phase-15 field: the live rollback MTTR tail
+    (``train_recovery_mttr_seconds_p95`` — the registry histogram's
+    percentile gauge, recoverable by construction: a one-off slow
+    restore re-arms once faster ones dominate the reservoir) judged
+    against the recorded ``recovery_mttr_s``. A sustained breach means
+    rollback restores got materially slower than the record — a grown
+    checkpoint, a slow disk, a quarantine walk that keeps walking —
+    exactly the degradation that turns "self-healing" back into
+    downtime. Wide default band: recovery is rare, so samples are few.
+    Same flightrec + audit trip machinery as every other watch."""
+    return (
+        Watch(
+            gauge="train_recovery_mttr_seconds_p95",
+            bench_fields=("recovery_mttr_s",),
+            direction="max",
+            tolerance=tolerance,
+        ),
+    )
+
+
 def default_watches(tolerance: float = 0.5) -> Tuple[Watch, ...]:
     """The stock lane guards: trainer throughput, gate eval throughput,
     fleet tail latency. Generous default band — committed records are

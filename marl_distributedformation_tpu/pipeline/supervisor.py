@@ -246,10 +246,27 @@ class AlwaysLearningPipeline:
             self._feed_falsifiers(verdict, tr.trace_id)
             return verdict
         t0 = time.perf_counter()
-        with tracer.span(
-            "promotion.publish", trace_id=tr.trace_id, step=verdict.step
-        ):
-            promoted = self.promoter.publish(path)
+        try:
+            with tracer.span(
+                "promotion.publish", trace_id=tr.trace_id, step=verdict.step
+            ):
+                promoted = self.promoter.publish(path)
+        except FileNotFoundError:
+            # The candidate vanished between gate verdict and publish —
+            # the trainer's retention ring pruned it (keep_last_n sized
+            # under the pipeline's lag, docs/recovery.md) or a rollback
+            # retracted it. A missing FILE is a skipped candidate, never
+            # a dead supervisor: audit it and let the stream move on (a
+            # newer checkpoint is usually the reason the old one was
+            # prunable at all).
+            registry.counter("pipeline_candidates_vanished_total").inc()
+            self.log.append(
+                "candidate_vanished",
+                step=verdict.step,
+                checkpoint=str(path),
+                trace_id=tr.trace_id,
+            )
+            return verdict
         tr.add("publish_s", time.perf_counter() - t0)
         if self.coordinator is not None:
             t0 = time.perf_counter()

@@ -6,6 +6,16 @@ from marl_distributedformation_tpu.train.trainer import (  # noqa: F401
     make_fused_chunk,
     make_ppo_iteration,
 )
+from marl_distributedformation_tpu.train.recovery import (  # noqa: F401
+    HealthConfig,
+    RecoveryConfig,
+    RecoveryLadder,
+    fold_recovery_key,
+    make_health_iteration,
+    read_recovery_log,
+    record_health_flags,
+    wrap_health,
+)
 from marl_distributedformation_tpu.train.sweep import (  # noqa: F401
     SweepTrainer,
 )

@@ -79,6 +79,7 @@ from marl_distributedformation_tpu.train.curriculum import (
     make_hetero_iteration,
     sample_stage_counts,
 )
+from marl_distributedformation_tpu.train.recovery import record_health_flags
 from marl_distributedformation_tpu.train.sweep import (
     population_aggregate,
     write_sweep_summary,
@@ -211,6 +212,12 @@ class HeteroSweepTrainer:
         iteration = make_hetero_iteration(
             self.env_params, ppo, self.per_formation
         )
+        # In-program health word + skip-update guard (train/recovery.py),
+        # wrapped before the vmap so each curriculum candidate carries
+        # and acts on its own flags.
+        from marl_distributedformation_tpu.train.recovery import wrap_health
+
+        iteration = wrap_health(iteration, config)
         iteration_pop = jax.vmap(iteration)
         if mesh is not None:
             # shard_map over the member axis (not bare jit-under-mesh):
@@ -489,6 +496,7 @@ class HeteroSweepTrainer:
                     )
                     if iteration % self.config.log_interval == 0:
                         host = jax.device_get(metrics)  # one batched pull
+                        record_health_flags(host)  # drain-seam counter
                         record = self._aggregate(host)
                         record["env_steps_per_sec"] = meter.rate()
                         record["curriculum_stage"] = float(stage_idx)
@@ -630,6 +638,10 @@ class HeteroSweepTrainer:
         ``(last_emitted_record, final_iteration_rewards)``."""
         host = jax.device_get(stacked)
         profiling.sample_device_watermark()  # drain boundary (ledger)
+        # Drain-seam health pin (train/recovery.py): per-member skips
+        # land in train_skipped_updates_total with the same batched
+        # device_get the telemetry already paid for.
+        record_health_flags(host)
         meter.tick(
             r * self.ppo.n_steps * self.config.num_formations
             * self.num_seeds

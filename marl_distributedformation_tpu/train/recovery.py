@@ -1,0 +1,511 @@
+"""Self-healing training lane: in-program health, host-side escalation.
+
+The other four lanes of the always-learning loop already degrade instead
+of dying — serving circuit-breaks and fails over, the pipeline watchdogs
+and rolls back, the mesh survives ``kill -9``, checkpoints quarantine
+their own corruption. The TRAIN lane did not: a diverged trainer (NaN
+loss, exploding grad norm, an actuator-fault curriculum pushed too hard
+by the adversarial feedback loop) either died on ``nan_guard`` or burned
+compute writing non-finite checkpoints for the gate to reject one at a
+time. Worse, fused dispatch (``fused_chunk=K``) commits K iterations per
+host round trip, so by the time the host SEES a bad metric the damage is
+K steps deep — detection has to ride *inside* the compiled program.
+
+Three layers (docs/recovery.md):
+
+1. **In-program health word** (:func:`make_health_iteration`): every
+   train iteration computes four flags — finite loss, finite global grad
+   norm, bounded global grad norm, bounded param-norm drift — packs them
+   into a ``health_word`` metric, and applies a ``jnp.where`` **skip-
+   update guard**: a flagged iteration carries the PREVIOUS state
+   through unchanged (the identity update) instead of committing the
+   poisoned one. The flags ride the existing stacked chunk metrics, so
+   the fused drain sees them at ZERO extra dispatches, budget-1 compile
+   receipts hold with health ON, and a healthy run's outputs are
+   BITWISE identical health ON vs OFF (``jnp.where(True, new, old)``
+   selects ``new`` exactly; tests/test_recovery.py pins it).
+
+2. **Host-side escalation ladder** (:class:`RecoveryLadder`), consumed
+   at the drain seam (never a per-iteration device probe — graftlint
+   rule 22 statically rejects that anti-pattern): skipped-update
+   counters -> sustained-breach ROLLBACK to the last-good checkpoint
+   with a folded-in recovery counter advancing the PRNG stream (the
+   retry must not bitwise-replay the divergence) and optional
+   lr/severity backoff -> bounded retries, then HALT with a flight
+   record. Every transition is one line in ``logs/{name}/recovery.jsonl``
+   and a ``train_*`` gauge in the merged metrics namespace.
+
+3. **Chaos closure**: the train-lane injection points
+   (``train.carry_poison`` / ``train.grad_bomb`` / ``train.snapshot``,
+   chaos/plane.py) plus ``scripts/chaos_storm.py --train`` drive NaN
+   bombs through a live fused run and check the lane's invariants: no
+   non-finite checkpoint ever becomes visible to discovery, the run
+   always terminates with finite params, recovery MTTR is bounded.
+
+This module imports jax/optax for the compiled half only; the ladder
+half records through obs/ lazily so a host process can import it
+without touching the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+RECOVERY_LOG = "recovery.jsonl"
+
+#: Health-word bit layout (a flagged iteration has at least one bit
+#: CLEAR; HEALTH_ALL means every check passed). The word rides the
+#: metrics stack as a float (metrics trees are homogeneous f32), decoded
+#: host-side by the ladder for recovery.jsonl detail.
+HEALTH_LOSS_FINITE = 1  # loss is finite
+HEALTH_GRAD_FINITE = 2  # global grad norm is finite
+HEALTH_GRAD_BOUNDED = 4  # global grad norm <= grad_norm_max
+HEALTH_DRIFT_BOUNDED = 8  # |params_new| <= drift_max * (|params_old|+1)
+HEALTH_ALL = (
+    HEALTH_LOSS_FINITE
+    | HEALTH_GRAD_FINITE
+    | HEALTH_GRAD_BOUNDED
+    | HEALTH_DRIFT_BOUNDED
+)
+
+#: The events a recovery.jsonl line may carry, with their REQUIRED keys
+#: (the schema :func:`read_recovery_log` round-trips).
+RECOVERY_EVENTS: Dict[str, tuple] = {
+    "skip": ("time", "event", "iteration", "skipped", "consecutive"),
+    "rollback": (
+        "time", "event", "iteration", "to_step", "recoveries", "mttr_s",
+    ),
+    "halt": ("time", "event", "iteration", "recoveries", "reason"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Bounds for the in-program health word. The defaults are
+    deliberately GENEROUS — the word exists to catch divergence (NaN,
+    1e18-scale explosions), not to police ordinary optimization noise;
+    a healthy run must never trip it (the bitwise ON==OFF pin depends
+    on that)."""
+
+    grad_norm_max: float = 1.0e6  # raw (pre-clip) global grad norm
+    #   bound — healthy pre-clip norms reach the hundreds at small
+    #   scales (measured), divergence shows up at 1e18+/NaN; the bound
+    #   sits orders of magnitude above the one and below the other
+    param_drift_max: float = 10.0  # per-iteration growth bound:
+    #   |p_new| <= param_drift_max * (|p_old| + 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryConfig:
+    """The host-side escalation ladder's knobs."""
+
+    breach_iters: int = 3  # consecutive skipped iterations = sustained
+    #   breach (a single transient skip is already contained by the
+    #   in-program guard and should NOT cost a rollback)
+    max_rollbacks: int = 3  # bounded retries; the next sustained breach
+    #   after the budget is spent HALTS the run with a flight record
+    lr_backoff: float = 1.0  # multiply the injected learning rate by
+    #   this on every rollback (needs the optimizer built with
+    #   inject_lr=True — the trainer does that automatically when this
+    #   is != 1.0; on a non-injected opt state the backoff is audited
+    #   as unavailable, never silently applied)
+    severity_backoff: float = 1.0  # multiply the scenario-schedule
+    #   severity scale by this on every rollback (pure host data — no
+    #   recompile; 1.0 = off)
+
+
+def make_health_iteration(iteration, health: HealthConfig):
+    """Wrap a training iteration ``(train_state, env_state, obs, key,
+    *extra) -> (train_state, env_state, obs, key, metrics)`` with the
+    in-program health word and the skip-update guard.
+
+    The wrapper adds two metrics — ``health_ok`` (1.0 when every check
+    passed) and ``health_word`` (the bit layout above) — and selects the
+    ENTIRE carry (train state incl. optimizer state and step counter,
+    env state, obs) back to the pre-iteration values when flagged; only
+    the PRNG key always advances, so the next iteration explores a
+    different stream instead of bitwise-replaying the poisoned one.
+    Pure data-flow: composes with ``jax.vmap`` (per-member flags and
+    per-member skips in the population sweeps) and ``make_fused_chunk``
+    (flags stack with the chunk metrics — zero extra dispatches).
+
+    On a healthy run ``jnp.where(True, new, old)`` selects ``new``
+    exactly, so outputs are bitwise identical to the unwrapped
+    iteration (the acceptance pin)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    gn_max = float(health.grad_norm_max)
+    drift_max = float(health.param_drift_max)
+
+    def health_iteration(train_state, env_state, obs, key, *extra):
+        new_ts, new_env, new_obs, new_key, metrics = iteration(
+            train_state, env_state, obs, key, *extra
+        )
+        loss_ok = jnp.isfinite(metrics["loss"])
+        grad_norm = metrics.get("grad_norm")
+        if grad_norm is None:
+            # An iteration that reports no grad norm (a custom core)
+            # passes the grad checks — present-or-vacuously-true, the
+            # loss/drift checks still stand.
+            grad_finite = jnp.asarray(True)
+            grad_bounded = jnp.asarray(True)
+        else:
+            grad_finite = jnp.isfinite(grad_norm)
+            # NaN <= x is False, so a non-finite norm fails BOTH flags.
+            grad_bounded = grad_norm <= jnp.asarray(gn_max, grad_norm.dtype)
+        p_old = optax.global_norm(train_state.params)
+        p_new = optax.global_norm(new_ts.params)
+        drift_ok = jnp.isfinite(p_new) & (
+            p_new <= jnp.asarray(drift_max, p_new.dtype) * (p_old + 1.0)
+        )
+        healthy = loss_ok & grad_finite & grad_bounded & drift_ok
+
+        def select(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(healthy, n, o), new, old
+            )
+
+        out_ts = select(new_ts, train_state)
+        out_env = select(new_env, env_state)
+        out_obs = jnp.where(healthy, new_obs, obs)
+        f32 = jnp.float32
+        word = (
+            loss_ok.astype(f32) * HEALTH_LOSS_FINITE
+            + grad_finite.astype(f32) * HEALTH_GRAD_FINITE
+            + grad_bounded.astype(f32) * HEALTH_GRAD_BOUNDED
+            + drift_ok.astype(f32) * HEALTH_DRIFT_BOUNDED
+        )
+        metrics = dict(metrics)
+        metrics["health_ok"] = healthy.astype(f32)
+        metrics["health_word"] = word
+        return out_ts, out_env, out_obs, new_key, metrics
+
+    return health_iteration
+
+
+def wrap_health(iteration, config) -> Any:
+    """The ONE health-wrapping seam every trainer shell shares
+    (single-run Trainer, SweepTrainer, HeteroSweepTrainer): returns
+    ``iteration`` wrapped with the in-program health word when
+    ``config.health`` is set, unchanged otherwise. ``config`` is any
+    object with the TrainConfig health knobs — a future bound threads
+    through here once instead of three copy-pasted sites."""
+    if not getattr(config, "health", False):
+        return iteration
+    return make_health_iteration(
+        iteration,
+        HealthConfig(
+            grad_norm_max=config.health_grad_norm_max,
+            param_drift_max=config.health_param_drift_max,
+        ),
+    )
+
+
+def fold_recovery_key(key, recoveries: int):
+    """Advance a restored PRNG key into the ``recoveries``-th retry
+    stream. The rollback restores the checkpoint's key verbatim — and a
+    verbatim key would bitwise-replay the exact dispatch sequence that
+    diverged. Folding the recovery counter (offset into a reserved tag
+    space so it can never collide with the rollout-index folds the
+    scenario sampler uses) gives every retry its own stream while
+    keeping recovery DETERMINISTIC: retry N from checkpoint C is a pure
+    function of (C, N), which is what makes the post-rollback
+    trajectory bit-exact reproducible (tests/test_recovery.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.random.fold_in(
+        jnp.asarray(key), 0x7EC0_0000 + int(recoveries)
+    )
+
+
+def scale_injected_lr(opt_state, factor: float):
+    """Scale an ``optax.inject_hyperparams`` learning rate IN the
+    optimizer state (pure data — no recompile, the whole point of the
+    injected spelling). Returns the new opt state, or None when no
+    ``learning_rate`` hyperparameter leaf exists (a plain
+    ``optax.adam(lr)`` bakes the rate into the compiled program — the
+    caller audits the backoff as unavailable instead of silently
+    no-opping)."""
+    import jax
+
+    found = []
+
+    def visit(path, leaf):
+        for entry in path:
+            name = getattr(entry, "key", getattr(entry, "name", None))
+            if name == "learning_rate":
+                found.append(True)
+                return leaf * factor
+        return leaf
+
+    scaled = jax.tree_util.tree_map_with_path(visit, opt_state)
+    return scaled if found else None
+
+
+def nonfinite_flag_count(host_metrics: Dict[str, Any]) -> int:
+    """Skipped-update count in a drained (host-side numpy) metrics
+    tree: the number of ``health_ok`` entries below 0.5, across every
+    axis (iterations x population members). 0 when health is off."""
+    flags = host_metrics.get("health_ok")
+    if flags is None:
+        return 0
+    return int((np.asarray(flags, dtype=np.float64) < 0.5).sum())
+
+
+def record_health_flags(host_metrics: Dict[str, Any]) -> int:
+    """THE drain-seam hook every driver shares (single-run trainer,
+    SweepTrainer, HeteroSweepTrainer): count this drain's skipped
+    updates into ``train_skipped_updates_total``. Host-side only —
+    the metrics are already numpy here (post ``device_get``)."""
+    skipped = nonfinite_flag_count(host_metrics)
+    if skipped:
+        from marl_distributedformation_tpu.obs.metrics import get_registry
+
+        get_registry().counter("train_skipped_updates_total").inc(skipped)
+    return skipped
+
+
+class RecoveryLadder:
+    """The host-side escalation ladder, fed per-iteration health flags
+    at the drain seam.
+
+    State machine (docs/recovery.md):
+
+    - ``observe`` walks the drained flags in iteration order; a healthy
+      iteration resets the consecutive-breach counter, an unhealthy one
+      advances it. Crossing ``breach_iters`` is a SUSTAINED breach:
+      verdict ``"rollback"`` while the retry budget lasts, ``"halt"``
+      after. Anything short of that is ``"ok"`` (the in-program guard
+      already contained it; a ``skip`` audit line still lands).
+    - The trainer performs the rollback (it owns the state) and calls
+      :meth:`note_rollback` with the measured MTTR; :meth:`note_halt`
+      latches the terminal state.
+    - Every transition appends one line to ``recovery.jsonl`` and lands
+      in the merged metrics namespace (``train_skipped_updates_total``,
+      ``train_divergence_events_total``, ``train_recoveries_total``,
+      ``train_recovery_mttr_seconds`` histogram, ``train_halted``).
+      Rollbacks and halts additionally dump a flight record.
+    """
+
+    def __init__(
+        self, config: RecoveryConfig, log_dir: str | Path
+    ) -> None:
+        self.config = config
+        self.log_path = Path(log_dir) / RECOVERY_LOG
+        # One file per PROCESS: the ladder's counters start at zero, so
+        # appending to a previous run's history would produce a log its
+        # own validator rejects (counter "jumping" back to 1, events
+        # after a terminal halt). A resumed run rotates the old history
+        # aside — preserved for forensics, invisible to the checker.
+        if self.log_path.exists() and self.log_path.stat().st_size > 0:
+            rotated = self.log_path.with_name(
+                f"{RECOVERY_LOG}.{int(time.time() * 1000)}"
+            )
+            try:
+                self.log_path.replace(rotated)
+            except OSError:
+                pass  # worst case: the checker sees a mixed file
+        self.recoveries = 0
+        self.skipped_total = 0
+        self.breaches = 0
+        self.halted = False
+        self._consecutive = 0
+        # The path the last rollback restored — cleared by the first
+        # fully-healthy observation after it. If a SECOND rollback finds
+        # this same file still newest, the file itself is the poison
+        # (finite-but-diverged params a grad bomb slipped past the
+        # non-finite write gate) and the trainer quarantines it before
+        # walking further back.
+        self.last_rollback_path: Optional[str] = None
+        # Post-rollback probation: detection lags one chunk, so the
+        # FIRST post-rollback save would land before that chunk's flags
+        # drain — if the restored state is itself poisoned (a finite
+        # grad bomb that beat the non-finite gate into the newest
+        # checkpoint), that save mints a fresh poisoned file at a newer
+        # step and the quarantine-on-retarget walk never converges
+        # (observed live). Probation holds until a fully-healthy chunk
+        # proves the restore stuck.
+        self._probation = False
+
+    @property
+    def suspect(self) -> bool:
+        """True while the most recent observation ended unhealthy OR a
+        rollback is still unproven (probation). The trainer gates
+        checkpoint SUBMISSION on this: a finite-but-diverged state
+        (grad bomb) passes the non-finite write gate, and writing one
+        per chunk would hand every rollback a fresh copy of the poison
+        at an ever-newer step — the quarantine-on-retarget walk only
+        converges when the suspect window writes nothing."""
+        return (self._consecutive > 0 or self._probation) and (
+            not self.halted
+        )
+
+    # -- the drain-seam feed ---------------------------------------------
+
+    def observe(
+        self,
+        ok_flags: Any,
+        words: Any = None,
+        first_iteration: int = 0,
+    ) -> str:
+        """One drained batch of per-iteration flags (host numpy, in
+        iteration order); returns the verdict: ``"ok"`` | ``"rollback"``
+        | ``"halt"``."""
+        from marl_distributedformation_tpu.obs.metrics import get_registry
+
+        if self.halted:
+            return "halt"
+        ok = np.asarray(ok_flags, dtype=np.float64).reshape(-1)
+        skipped = int((ok < 0.5).sum())
+        self.skipped_total += skipped
+        registry = get_registry()
+        if skipped:
+            registry.counter("train_skipped_updates_total").inc(skipped)
+        breach = False
+        for value in ok:
+            if value >= 0.5:
+                self._consecutive = 0
+            else:
+                self._consecutive += 1
+                if self._consecutive >= self.config.breach_iters:
+                    breach = True
+        registry.gauge("train_consecutive_unhealthy").set(
+            float(self._consecutive)
+        )
+        if skipped == 0 and self._consecutive == 0:
+            # Healthy progress: the last rollback target held — lift
+            # probation and forget the retarget memo.
+            self.last_rollback_path = None
+            self._probation = False
+            return "ok"
+        word_min: Optional[int] = None
+        if words is not None:
+            w = np.asarray(words, dtype=np.float64).reshape(-1)
+            if w.size:
+                word_min = int(w.min())
+        self._append({
+            "event": "skip",
+            "iteration": int(first_iteration),
+            "skipped": skipped,
+            "consecutive": int(self._consecutive),
+            "health_word_min": word_min,
+        })
+        if not breach:
+            return "ok"
+        self.breaches += 1
+        registry.counter("train_divergence_events_total").inc()
+        if self.recoveries >= self.config.max_rollbacks:
+            return "halt"
+        return "rollback"
+
+    # -- transitions (the trainer calls these after acting) ---------------
+
+    def note_rollback(
+        self,
+        to_step: int,
+        path: Optional[str],
+        mttr_s: float,
+        iteration: int,
+        lr_scale: Optional[float] = None,
+        severity_scale: Optional[float] = None,
+    ) -> None:
+        from marl_distributedformation_tpu.obs import (
+            get_registry,
+            get_tracer,
+        )
+
+        self.recoveries += 1
+        self._consecutive = 0
+        self._probation = True  # saves stay suspended until a healthy
+        #   chunk proves the restore stuck (see __init__)
+        self.last_rollback_path = str(path) if path is not None else None
+        registry = get_registry()
+        registry.counter("train_recoveries_total").inc()
+        registry.histogram("train_recovery_mttr_seconds").observe(
+            float(mttr_s)
+        )
+        record = {
+            "event": "rollback",
+            "iteration": int(iteration),
+            "to_step": int(to_step),
+            "recoveries": int(self.recoveries),
+            "mttr_s": round(float(mttr_s), 4),
+            "checkpoint": str(path) if path is not None else None,
+            "lr_scale": lr_scale,
+            "severity_scale": severity_scale,
+        }
+        get_tracer().incident("train_rollback", **record)
+        self._append(record)
+
+    def note_halt(self, iteration: int, reason: str) -> None:
+        from marl_distributedformation_tpu.obs import (
+            get_registry,
+            get_tracer,
+        )
+
+        self.halted = True
+        get_registry().gauge("train_halted").set(1.0)
+        record = {
+            "event": "halt",
+            "iteration": int(iteration),
+            "recoveries": int(self.recoveries),
+            "reason": str(reason)[:300],
+        }
+        get_tracer().incident("train_divergence_halt", **record)
+        self._append(record)
+
+    # -- the audit log -----------------------------------------------------
+
+    def _append(self, record: Dict[str, Any]) -> None:
+        line = {"time": round(time.time(), 3), **record}
+        try:
+            self.log_path.parent.mkdir(parents=True, exist_ok=True)
+            with open(self.log_path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+        except OSError:
+            pass  # the audit trail must never become the failure mode
+
+
+def read_recovery_log(path: str | Path) -> List[Dict[str, Any]]:
+    """Parse + validate ``recovery.jsonl``: every line JSON, every event
+    known, every required key present (:data:`RECOVERY_EVENTS` is the
+    schema). Raises ``ValueError`` naming the first offending line —
+    the round-trip contract tests/test_recovery.py pins and the chaos
+    invariant checker builds on. A missing file is an empty history."""
+    path = Path(path)
+    if not path.exists():
+        return []
+    records: List[Dict[str, Any]] = []
+    for i, raw in enumerate(path.read_text().splitlines()):
+        if not raw.strip():
+            continue
+        try:
+            rec = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"{path}:{i + 1}: unparseable recovery line: {e}"
+            ) from e
+        event = rec.get("event")
+        required = RECOVERY_EVENTS.get(event)
+        if required is None:
+            raise ValueError(
+                f"{path}:{i + 1}: unknown recovery event {event!r} "
+                f"(known: {sorted(RECOVERY_EVENTS)})"
+            )
+        missing = [k for k in required if k not in rec]
+        if missing:
+            raise ValueError(
+                f"{path}:{i + 1}: {event!r} line is missing required "
+                f"key(s) {missing}"
+            )
+        records.append(rec)
+    return records

@@ -77,6 +77,7 @@ from marl_distributedformation_tpu.env.formation import (
 )
 from marl_distributedformation_tpu.jax_compat import shard_map
 from marl_distributedformation_tpu.models import MLPActorCritic
+from marl_distributedformation_tpu.train.recovery import record_health_flags
 from marl_distributedformation_tpu.train.trainer import (
     TrainConfig,
     default_total_timesteps,
@@ -314,6 +315,14 @@ class SweepTrainer:
         iteration = make_ppo_iteration(
             env_params, ppo, self.per_formation, None
         )
+        # In-program health word + skip-update guard (train/recovery.py):
+        # wrapped BEFORE the vmap, so every member carries its OWN flags
+        # and a diverged member skips its own updates while the rest of
+        # the population trains on. Flags stack into the chunk metrics
+        # like any other entry; the drain seam counts the skips.
+        from marl_distributedformation_tpu.train.recovery import wrap_health
+
+        iteration = wrap_health(iteration, config)
         iteration_pop = jax.vmap(iteration)
         if mesh is not None:
             # shard_map over the seed axis, not bare jit-under-mesh: each
@@ -801,6 +810,7 @@ class SweepTrainer:
                 )
                 if iteration % self.config.log_interval == 0:
                     host = self._to_host(metrics)  # one batched pull
+                    record_health_flags(host)  # drain-seam skip counter
                     record = self._aggregate(host)
                     record["env_steps_per_sec"] = meter.rate()
                     logger.log(record, self.num_timesteps)
@@ -907,6 +917,10 @@ class SweepTrainer:
         summary."""
         host = jax.device_get(stacked)
         profiling.sample_device_watermark()  # drain boundary (ledger)
+        # Drain-seam health pin (train/recovery.py): per-member skips
+        # land in train_skipped_updates_total — the flags arrived in
+        # the same batched device_get as the rest of the telemetry.
+        record_health_flags(host)
         meter.tick(
             self._fused_chunk
             * self.ppo.n_steps

@@ -18,6 +18,7 @@ import dataclasses
 import functools
 import threading
 import time
+from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -30,6 +31,10 @@ from marl_distributedformation_tpu.algo import (
     collect_rollout,
     compute_gae,
     ppo_update,
+)
+from marl_distributedformation_tpu.chaos.plane import (
+    InjectedFault,
+    fault_point,
 )
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.env.formation import compute_obs, reset_batch
@@ -96,6 +101,35 @@ class TrainConfig:
     #   uploads during tracing are legitimate)
     guard_nans: bool = False  # jax_debug_nans around every dispatch: ops
     #   producing NaN re-run op-by-op and raise at the source op
+    # Self-healing train lane (train/recovery.py, docs/recovery.md).
+    health: bool = False  # in-program health word + skip-update guard:
+    #   every iteration computes finite-loss / bounded-grad-norm /
+    #   param-drift flags and carries the PREVIOUS state through when
+    #   flagged (identity update). Flags ride the stacked chunk metrics
+    #   (zero extra dispatches); healthy-run outputs are bitwise
+    #   identical health on vs off, and budget-1 receipts hold.
+    health_grad_norm_max: float = 1.0e6  # raw global-grad-norm bound
+    #   (healthy pre-clip norms reach the hundreds; divergence is
+    #   1e18+/NaN — see train/recovery.py)
+    health_param_drift_max: float = 10.0  # |p_new| <= this * (|p_old|+1)
+    recovery: bool = False  # host-side escalation ladder at the drain
+    #   seam (requires health=true): sustained breach -> rollback to the
+    #   last-good checkpoint with a folded-in recovery counter advancing
+    #   the PRNG stream -> bounded retries -> halt with flight record.
+    #   Transitions land in logs/{name}/recovery.jsonl + train_* gauges.
+    recovery_breach_iters: int = 3  # consecutive skipped iterations
+    #   that count as a sustained breach
+    recovery_max_rollbacks: int = 3  # retry budget before halting
+    recovery_lr_backoff: float = 1.0  # per-rollback learning-rate
+    #   multiplier (!= 1.0 builds the optimizer with inject_hyperparams
+    #   so the rate lives in opt state — note that changes the opt-state
+    #   layout vs default checkpoints)
+    recovery_severity_backoff: float = 1.0  # per-rollback scenario
+    #   severity multiplier (pure schedule data — no recompile)
+    keep_last_n: int = 0  # checkpoint retention ring: keep only the
+    #   newest N rl_model_* checkpoints (0 = unbounded, the legacy
+    #   behavior). Quarantine-aware and never prunes the recovery
+    #   ladder's current last-good rollback target.
 
 
 def default_total_timesteps(config: "TrainConfig") -> int:
@@ -265,10 +299,16 @@ def make_fused_chunk(iteration, k: int, reduce_metrics: bool = False):
             body, (train_state, env_state, obs, key), xs, length=k
         )
         if reduce_metrics:
+            # episode_dones sums; the health flags reduce by MIN (the
+            # burst is healthy only if every fused iteration was — a
+            # mean would dilute a single skip below detection); the
+            # rest mean, the legacy burst contract.
             stacked = {
                 name: (
                     v.sum(axis=0)
                     if name == "episode_dones"
+                    else v.min(axis=0)
+                    if name.startswith("health_")
                     else v.mean(axis=0)
                 )
                 for name, v in stacked.items()
@@ -318,10 +358,16 @@ class Trainer:
         else:
             dummy_obs = jnp.zeros((1, env_params.obs_dim), jnp.float32)
         params = self.model.init(k_init, dummy_obs)
+        # lr backoff needs the rate IN the optimizer state (pure data,
+        # no recompile on a rollback) — inject only when the knob is
+        # live so the default opt-state layout (and its checkpoints)
+        # stays bit-identical.
         self.train_state = TrainState.create(
             apply_fn=self.model.apply,
             params=params,
-            tx=ppo.make_optimizer(),
+            tx=ppo.make_optimizer(
+                inject_lr=config.recovery_lr_backoff != 1.0
+            ),
         )
 
         self._shard_fn = shard_fn
@@ -387,6 +433,11 @@ class Trainer:
         self._scenario_step_fn = None
         self.scenario_params = None
         self.scenario_severity = 0.0
+        # Recovery severity backoff (train/recovery.py): multiplies
+        # every sampled severity; 1.0 (always, until a rollback with
+        # recovery_severity_backoff != 1.0) keeps the sampling path
+        # bitwise untouched. Set BEFORE the first resample below.
+        self._severity_scale = 1.0
         # Per-iteration severities of the most recent chunked dispatch
         # (what the fused driver logs) — written by _next_scenario_chunk.
         self._last_chunk_severities = None
@@ -450,6 +501,50 @@ class Trainer:
         self.num_timesteps = 0
         self._vec_steps_since_save = 0
         self._iteration_core = self._make_iteration()
+        # Self-healing train lane (train/recovery.py, docs/recovery.md):
+        # the in-program health word + skip-update guard wrap the
+        # functional core BEFORE fusion, so host-loop, burst, and fused
+        # dispatch all carry the same flags in their metrics.
+        if config.health:
+            from marl_distributedformation_tpu.train.recovery import (
+                wrap_health,
+            )
+
+            self._iteration_core = wrap_health(
+                self._iteration_core, config
+            )
+        self.halted = False
+        self.recovery_ladder = None
+        self._recovery_verdict: Optional[str] = None
+        self._last_good_ckpt: Optional[Path] = None
+        self._rollback_anchor: Optional[Dict[str, Any]] = None
+        if config.recovery:
+            if not config.health:
+                raise SystemExit(
+                    "recovery=true needs health=true — the escalation "
+                    "ladder consumes the in-program health flags at the "
+                    "drain seam; without them it is blind"
+                )
+            if self._multihost:
+                raise SystemExit(
+                    "the recovery ladder is single-host for now "
+                    "(rollback restore has no cross-host broadcast "
+                    "seam); drop recovery or run single-process"
+                )
+            from marl_distributedformation_tpu.train.recovery import (
+                RecoveryConfig,
+                RecoveryLadder,
+            )
+
+            self.recovery_ladder = RecoveryLadder(
+                RecoveryConfig(
+                    breach_iters=config.recovery_breach_iters,
+                    max_rollbacks=config.recovery_max_rollbacks,
+                    lr_backoff=config.recovery_lr_backoff,
+                    severity_backoff=config.recovery_severity_backoff,
+                ),
+                config.log_dir or str(repo_root() / "logs" / config.name),
+            )
         self._iters_per_dispatch = max(1, int(config.iters_per_dispatch))
         self._fused_chunk = max(0, int(config.fused_chunk))
         if self._fused_chunk and self._iters_per_dispatch > 1:
@@ -512,6 +607,14 @@ class Trainer:
 
         if config.resume:
             self._try_resume()
+        if self.recovery_ladder is not None:
+            # Last-resort rollback target: a host copy of the run's
+            # starting state (post-resume), so divergence BEFORE the
+            # first checkpoint still recovers instead of halting with
+            # nothing to restore.
+            self._rollback_anchor = jax.device_get(
+                self._checkpoint_target()
+            )
 
     # ------------------------------------------------------------------
     # Functional core
@@ -639,6 +742,13 @@ class Trainer:
         rollout, values-only so the train step never retraces)."""
         schedule = self._scenario_schedule
         self.scenario_severity = schedule.severity_at(self._scenario_rollouts)
+        if self._severity_scale != 1.0:
+            # Recovery severity backoff (train/recovery.py): pure data,
+            # applied at the sampling seam — the schedule object itself
+            # stays untouched so a later scale reset is exact.
+            self.scenario_severity = (
+                self.scenario_severity * self._severity_scale
+            )
         k_sample = jax.random.fold_in(
             self._scenario_base_key, self._scenario_draws
         )
@@ -669,6 +779,10 @@ class Trainer:
             self._scenario_base_key, jnp.arange(d0, d0 + k)
         )
         severities = schedule.severity_chunk(r0, k)
+        if self._severity_scale != 1.0:
+            # Recovery severity backoff: scale the whole chunk's row;
+            # the stash below then logs the severities ACTUALLY trained.
+            severities = [s * self._severity_scale for s in severities]
         self._last_chunk_severities = severities
         return self._sample_scenario_chunk(
             keys,
@@ -689,6 +803,20 @@ class Trainer:
         training), under the opt-in runtime guards, and advance the host
         counters. Shared by the host-loop and fused-scan shells."""
         self._apply_pending_schedule()
+        # Train-lane chaos seams (chaos/plane.py, docs/chaos.md): a
+        # 'raise' armed at the poison points is interpreted HERE, at the
+        # dispatch boundary, as state corruption — a NaN bomb into the
+        # carry, or a finite 1e18 scale whose gradients explode — the
+        # deterministic stand-ins for organic divergence the health word
+        # + recovery ladder exist to absorb. Host-side only (rule 19).
+        try:
+            fault_point("train.carry_poison")
+        except InjectedFault:
+            self._poison_carry(float("nan"))
+        try:
+            fault_point("train.grad_bomb")
+        except InjectedFault:
+            self._poison_carry(1.0e18)
         with contextlib.ExitStack() as stack:
             if self.config.guard_transfers and self._dispatches > 0:
                 # Post-warmup only: the compile dispatch legitimately
@@ -776,7 +904,9 @@ class Trainer:
             self.log_dir, self.config.profile, self.config.profile_iterations
         )
         try:
-            while self.num_timesteps < self.total_timesteps:
+            while self.num_timesteps < self.total_timesteps and (
+                not self.halted
+            ):
                 tracer.before_dispatch()
                 metrics = self.run_iteration()
                 iteration += 1
@@ -795,8 +925,16 @@ class Trainer:
                     # single batched device_get, NOT per-metric float():
                     # on a tunneled TPU each transfer pays full RTT, and
                     # ~16 of them per iteration can cost more than the
-                    # iteration itself.
+                    # iteration itself. The health flags ride the SAME
+                    # sync — never a per-iteration finiteness probe
+                    # (graftlint rule 22), so with log_interval > 1 the
+                    # host-loop ladder observes at log cadence.
                     host_metrics = jax.device_get(metrics)
+                    if self._observe_health(host_metrics, iteration):
+                        # Rolled back (or halted): the state was
+                        # restored; this dispatch's record is poisoned
+                        # telemetry — drop it and continue/stop.
+                        continue
                     last_record = {
                         k: float(v) for k, v in host_metrics.items()
                     }
@@ -819,8 +957,38 @@ class Trainer:
                     self.config.checkpoint
                     and self._vec_steps_since_save >= self.config.save_freq
                 ):
-                    self.save()
-            if self.config.checkpoint:
+                    if (
+                        self.recovery_ladder is not None
+                        and iteration % self.config.log_interval != 0
+                    ):
+                        # With log_interval > 1 this dispatch's flags
+                        # were never drained — and publishing an
+                        # unobserved state can mint a finite-but-
+                        # poisoned checkpoint at a newer step per save,
+                        # outrunning the quarantine walk. The save
+                        # boundary is already an IO seam, so one small
+                        # flag pull here is not the per-iteration probe
+                        # rule 22 bans.
+                        flags = jax.device_get({
+                            k: metrics[k]
+                            for k in ("health_ok", "health_word")
+                            if k in metrics
+                        })
+                        if self._observe_health(flags, iteration):
+                            continue  # rolled back: nothing to save
+                    if not self._saves_suspended():
+                        self.save()
+            if self.recovery_ladder is not None and not self.halted:
+                # Run-end guarantee, host-loop flavor (the fused driver
+                # has its own call): finite final params even when a
+                # tail poison never tripped the ladder.
+                self._ensure_finite_final_state(None, iteration)
+            if self.config.checkpoint and not self._saves_suspended():
+                # The final save honors the suspect window too: a
+                # finite-but-diverged tail state (shorter than
+                # breach_iters) must not become the newest discoverable
+                # checkpoint — the last-good file already on disk is
+                # the state worth resuming.
                 self.save()
         finally:
             tracer.close()
@@ -847,7 +1015,14 @@ class Trainer:
             use_tensorboard=self.config.use_tensorboard,
         )
         meter = Throughput()
-        writer = AsyncCheckpointWriter() if self.config.checkpoint else None
+        writer = (
+            AsyncCheckpointWriter(
+                keep_last_n=self.config.keep_last_n,
+                protect=self._protected_paths,
+            )
+            if self.config.checkpoint
+            else None
+        )
         # Chunk-granular profile=true: trace profile_iterations whole
         # chunks post-warmup — one dispatch is one chunk here.
         tracer = profiling.TraceWindow(
@@ -858,7 +1033,9 @@ class Trainer:
         iteration = 0
         pending = None  # the chunk in flight, drained one dispatch later
         try:
-            while self.num_timesteps < self.total_timesteps:
+            while self.num_timesteps < self.total_timesteps and (
+                not self.halted
+            ):
                 steps_before = self.num_timesteps
                 tracer.before_dispatch()
                 stacked = self.run_chunk()
@@ -874,19 +1051,38 @@ class Trainer:
                         self._drain_chunk(logger, meter, *pending)
                         or last_record
                     )
+                    if self._act_on_recovery_verdict(writer, iteration):
+                        # Rolled back (or halted): the chunk just
+                        # dispatched trained FROM the diverged state —
+                        # abandon it undrained and restart the pipeline
+                        # from the restored state.
+                        pending = None
+                        continue
                 pending = (stacked, iteration, steps_before, severities)
                 iteration += k
                 if (
                     writer is not None
                     and self._vec_steps_since_save >= self.config.save_freq
+                    and not self._saves_suspended()
                 ):
                     self.save_async(writer)
             if pending is not None:
                 last_record = (
                     self._drain_chunk(logger, meter, *pending) or last_record
                 )
+                self._act_on_recovery_verdict(writer, iteration)
+            if self.recovery_ladder is not None and not self.halted:
+                # Terminal guarantee: the run must END on finite params
+                # even when the budget expired mid-breach (a tail poison
+                # shorter than breach_iters never trips the ladder). ONE
+                # host check at run end — never inside the dispatch loop.
+                self._ensure_finite_final_state(writer, iteration)
             if writer is not None:
-                self.save_async(writer)
+                if not self._saves_suspended():
+                    # Suspect tail states stay unpublished (see the
+                    # host loop's final save) — the ring's last-good
+                    # file is the resume point.
+                    self.save_async(writer)
                 writer.close()  # the final write is durable before return
                 writer = None
         finally:
@@ -935,6 +1131,25 @@ class Trainer:
         # sample costs no extra pipeline stall (obs/ledger.py).
         profiling.sample_device_watermark()
         self._record_lane_metrics(meter.rate())
+        if "health_ok" in host:
+            # The drain seam IS the detection seam: the health flags
+            # arrived in the same batched device_get as the rest of the
+            # chunk telemetry (zero extra syncs), so a divergence is
+            # seen within ONE chunk drain of the poisoned dispatch. The
+            # ladder's verdict is acted on by the driver loop (it owns
+            # the in-flight chunk and the writer).
+            if self.recovery_ladder is not None:
+                self._recovery_verdict = self.recovery_ladder.observe(
+                    host["health_ok"],
+                    host.get("health_word"),
+                    first_iteration,
+                )
+            else:
+                from marl_distributedformation_tpu.train.recovery import (
+                    record_health_flags,
+                )
+
+                record_health_flags(host)
         per_iter = self.ppo.n_steps * self.num_envs
         last_record: Dict[str, float] = {}
         for i in range(self._fused_chunk):
@@ -948,6 +1163,257 @@ class Trainer:
             last_record = record
         return last_record
 
+    # ------------------------------------------------------------------
+    # Recovery ladder actions (train/recovery.py, docs/recovery.md)
+    # ------------------------------------------------------------------
+
+    def _saves_suspended(self) -> bool:
+        """Checkpoint cadence gate: while the ladder's most recent
+        observation ended unhealthy, submit NOTHING. A finite-but-
+        diverged state (grad bomb) passes the non-finite write gate;
+        writing one per chunk would hand every rollback a fresh copy of
+        the poison at an ever-newer step, defeating the quarantine-on-
+        retarget walk. The first poisoned pre-detection write is
+        unavoidable (detection lags one chunk) — that one file is
+        exactly what the walk quarantines."""
+        return (
+            self.recovery_ladder is not None
+            and self.recovery_ladder.suspect
+        )
+
+    def _poison_carry(self, value: float) -> None:
+        """Chaos effect for the ``train.carry_poison`` / ``train.
+        grad_bomb`` seams: corrupt the LIVE device params at the
+        dispatch boundary (NaN kills the loss; a finite 1e18 scale
+        explodes the gradients) — the deterministic stand-in for
+        organic divergence."""
+        poison = jnp.float32(value)
+        self.train_state = self.train_state.replace(
+            params=jax.tree_util.tree_map(
+                lambda p: p * poison, self.train_state.params
+            )
+        )
+
+    def _observe_health(self, host_metrics, iteration: int) -> bool:
+        """Host-loop seam: feed the just-synced health flags to the
+        ladder and act on its verdict. Returns True when the state was
+        restored (rollback or halt) — the caller drops the poisoned
+        record and continues (or stops)."""
+        if "health_ok" not in host_metrics:
+            return False
+        if self.recovery_ladder is None:
+            from marl_distributedformation_tpu.train.recovery import (
+                record_health_flags,
+            )
+
+            record_health_flags(host_metrics)
+            return False
+        self._recovery_verdict = self.recovery_ladder.observe(
+            host_metrics["health_ok"],
+            host_metrics.get("health_word"),
+            iteration,
+        )
+        return self._act_on_recovery_verdict(None, iteration)
+
+    def _act_on_recovery_verdict(
+        self, writer: Optional[AsyncCheckpointWriter], iteration: int
+    ) -> bool:
+        """Consume the verdict the last drain stored; perform the
+        rollback / halt. Returns True when state was restored."""
+        verdict, self._recovery_verdict = self._recovery_verdict, None
+        if verdict in (None, "ok"):
+            return False
+        if verdict == "rollback":
+            self._perform_rollback(writer, iteration)
+            return True
+        self._perform_rollback(
+            writer,
+            iteration,
+            halt_reason=(
+                "sustained divergence with the rollback budget "
+                f"exhausted ({self.recovery_ladder.recoveries} "
+                "recoveries spent)"
+            ),
+        )
+        return True
+
+    def _perform_rollback(
+        self,
+        writer: Optional[AsyncCheckpointWriter],
+        iteration: int,
+        halt_reason: Optional[str] = None,
+    ) -> None:
+        """Restore the newest VALID last-good state (checkpoint walk, or
+        the run-start anchor when none exists), advance the PRNG stream
+        past the divergence via the folded recovery counter, and apply
+        the configured lr/severity backoff. With ``halt_reason`` the
+        restore is terminal: the run ends here, on finite params, with
+        a flight record."""
+        from marl_distributedformation_tpu.train.recovery import (
+            fold_recovery_key,
+            scale_injected_lr,
+        )
+        from marl_distributedformation_tpu.utils.checkpoint import (
+            quarantine_checkpoint,
+        )
+
+        t0 = time.perf_counter()
+        ladder = self.recovery_ladder
+        if writer is not None:
+            try:
+                # Join the in-flight write: it may be publishing the very
+                # last-good file the walk below should find (or skipping
+                # a poisoned one — the non-finite gate's audit trail owns
+                # that).
+                writer.wait()
+            except RuntimeError:
+                pass  # a failed WRITE must never block recovery; the
+                #   skip/quarantine audit trail already recorded it
+        found = None
+        if self.config.checkpoint:
+            for _ in range(8):
+                found = restore_latest_partial(
+                    self.log_dir, self._checkpoint_target()
+                )
+                if (
+                    found is not None
+                    and ladder is not None
+                    and ladder.last_rollback_path == str(found[0])
+                ):
+                    # The previous rollback restored THIS file and the
+                    # run re-diverged without any healthy progress: the
+                    # checkpoint itself carries the poison (finite-but-
+                    # diverged params slip past the non-finite write
+                    # gate). Quarantine it and walk further back.
+                    quarantine_checkpoint(
+                        found[0],
+                        "rollback target re-diverged (finite but "
+                        "unhealthy state); walking back",
+                    )
+                    found = None
+                    continue
+                break
+        if found is not None:
+            path, restored = found
+        else:
+            path, restored = None, dict(self._rollback_anchor)
+        restored = own_restored(restored)
+        self.train_state = self.train_state.replace(
+            params=restored["params"],
+            opt_state=restored.get("opt_state", self.train_state.opt_state),
+        )
+        if "key" in restored:
+            self.key = jnp.asarray(restored["key"])
+        self.num_timesteps = int(restored["num_timesteps"])
+        if "env_state" in restored:
+            self.env_state = restored["env_state"]
+            self.obs = restored["obs"]
+        if self._shard_fn is not None:
+            self.train_state, self.env_state, self.obs = self._shard_fn(
+                self.train_state, self.env_state, self.obs
+            )
+        recoveries_next = (ladder.recoveries if ladder is not None else 0) + 1
+        # The retry must not bitwise-replay the divergence: fold the
+        # recovery counter into the restored key (deterministic — retry
+        # N from checkpoint C is a pure function of (C, N)).
+        self.key = fold_recovery_key(self.key, recoveries_next)
+        lr_scale = None
+        if self.config.recovery_lr_backoff != 1.0:
+            scaled = scale_injected_lr(
+                self.train_state.opt_state, self.config.recovery_lr_backoff
+            )
+            if scaled is not None:
+                self.train_state = self.train_state.replace(opt_state=scaled)
+                lr_scale = self.config.recovery_lr_backoff
+            else:
+                from marl_distributedformation_tpu.obs import get_tracer
+
+                get_tracer().incident(
+                    "train_lr_backoff_unavailable",
+                    detail="opt state carries no injected learning_rate "
+                    "leaf; backoff skipped",
+                )
+        severity_scale = None
+        if (
+            self.config.recovery_severity_backoff != 1.0
+            and self._scenario_schedule is not None
+        ):
+            self._severity_scale *= self.config.recovery_severity_backoff
+            severity_scale = self._severity_scale
+        if self._scenario_schedule is not None:
+            self._scenario_rollouts = self.num_timesteps // (
+                self.ppo.n_steps * self.num_envs
+            )
+            # The draw counter NEVER rewinds (the no-replay law the
+            # curriculum feedback loop already obeys) — the retry draws
+            # fresh domain randomization instead of replaying the
+            # possibly-divergence-inducing draws.
+            self._scenario_draws = max(
+                self._scenario_draws, self._scenario_rollouts
+            )
+            self._resample_scenario_params()
+        self._vec_steps_since_save = 0
+        if path is not None:
+            self._last_good_ckpt = Path(path)
+        mttr_s = time.perf_counter() - t0
+        if ladder is None:
+            return
+        if halt_reason is None:
+            ladder.note_rollback(
+                to_step=self.num_timesteps,
+                path=str(path) if path is not None else None,
+                mttr_s=mttr_s,
+                iteration=iteration,
+                lr_scale=lr_scale,
+                severity_scale=severity_scale,
+            )
+        else:
+            ladder.note_halt(iteration, halt_reason)
+            self.halted = True
+
+    def _ensure_finite_final_state(
+        self, writer: Optional[AsyncCheckpointWriter], iteration: int
+    ) -> None:
+        """Run-end guarantee: finite final params, even when the budget
+        expired mid-breach (a tail poison shorter than breach_iters
+        never trips the ladder; this terminal restore may exceed the
+        retry budget by one — it is a guarantee, not a retry). One host
+        pull, outside the dispatch loop."""
+        from marl_distributedformation_tpu.utils.checkpoint import (
+            nonfinite_leaf,
+        )
+
+        if nonfinite_leaf(
+            jax.device_get(self.train_state.params)
+        ) is not None:
+            self._perform_rollback(writer, iteration)
+
+    def _protected_paths(self):
+        """Retention-ring protection set: the ladder's current last-good
+        rollback target must survive pruning no matter how old it is."""
+        return (
+            {self._last_good_ckpt}
+            if self._last_good_ckpt is not None
+            else set()
+        )
+
+    def _snapshot_for_write(self) -> Dict[str, Any]:
+        """The checkpoint target, through the ``train.snapshot`` chaos
+        seam: an armed fault poisons the SNAPSHOT copy (never the live
+        carry) — checkpoint-time state corruption, which the non-finite
+        write gate (utils/checkpoint.py) must keep invisible to
+        discovery."""
+        target = self._checkpoint_target()
+        try:
+            fault_point("train.snapshot")
+        except InjectedFault:
+            poison = jnp.float32(float("nan"))
+            target = dict(target)
+            target["params"] = jax.tree_util.tree_map(
+                lambda p: p * poison, target["params"]
+            )
+        return target
+
     def save_async(self, writer: AsyncCheckpointWriter) -> str:
         """Chunk-boundary checkpoint that never stalls the dispatch
         pipeline: snapshot the state on DEVICE (async copies enqueued
@@ -956,10 +1422,20 @@ class Trainer:
         snapshot to the writer thread, which ``device_get``s and writes
         atomically while the device keeps training."""
         path = checkpoint_path(self.log_dir, self.num_timesteps)
+        on_checkpoint = self.on_checkpoint
+
+        def on_done(p) -> None:
+            # Runs on the writer thread AFTER the rename lands — i.e.
+            # the file passed the non-finite gate and is durably
+            # discoverable: the newest valid rollback target.
+            self._last_good_ckpt = Path(p)
+            if on_checkpoint is not None:
+                on_checkpoint(p)
+
         writer.submit(
             path,
-            device_snapshot(self._checkpoint_target()),
-            on_done=self.on_checkpoint,
+            device_snapshot(self._snapshot_for_write()),
+            on_done=on_done,
         )
         self._vec_steps_since_save = 0
         return str(path)
@@ -1121,13 +1597,27 @@ class Trainer:
     def save(self) -> Optional[str]:
         """Write a checkpoint; returns its path on the coordinator process
         and None on every other host (the file exists only on the
-        coordinator's disk — see utils.save_checkpoint)."""
+        coordinator's disk — see utils.save_checkpoint) or when the
+        non-finite write gate skipped a poisoned state (audited —
+        docs/recovery.md)."""
         path = save_checkpoint(
-            self.log_dir, self.num_timesteps, self._checkpoint_target()
+            self.log_dir, self.num_timesteps, self._snapshot_for_write()
         )
         self._vec_steps_since_save = 0
-        if path is not None and self.on_checkpoint is not None:
-            self.on_checkpoint(path)
+        if path is not None:
+            self._last_good_ckpt = Path(path)
+            if self.config.keep_last_n > 0:
+                from marl_distributedformation_tpu.utils.checkpoint import (
+                    prune_checkpoints,
+                )
+
+                prune_checkpoints(
+                    self.log_dir,
+                    self.config.keep_last_n,
+                    protect=self._protected_paths(),
+                )
+            if self.on_checkpoint is not None:
+                self.on_checkpoint(path)
         return str(path) if path is not None else None
 
     def _learner_template(self) -> Dict[str, Any]:

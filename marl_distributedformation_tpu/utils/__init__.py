@@ -18,10 +18,12 @@ from marl_distributedformation_tpu.utils.checkpoint import (  # noqa: F401
     checkpoint_path,
     checkpoint_step,
     device_snapshot,
+    NonFiniteCheckpointError,
     latest_checkpoint,
     latest_sweep_state,
     msgpack_restore_file,
     own_restored,
+    prune_checkpoints,
     quarantine_checkpoint,
     read_checkpoint_payload,
     restore_checkpoint,

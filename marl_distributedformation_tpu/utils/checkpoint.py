@@ -71,7 +71,14 @@ def save_checkpoint(
     path = checkpoint_path(log_dir, num_timesteps)
     on_coordinator = is_coordinator()
     if on_coordinator:
-        _write_atomic(path, target)
+        try:
+            _write_atomic(path, target)
+        except NonFiniteCheckpointError as e:
+            # Degrade, never die — and never skip the durability barrier
+            # below (peers must not hang on a coordinator that refused a
+            # poisoned write).
+            _audit_nonfinite_skip(path, str(e))
+            path = None
     if sync and jax.process_count() > 1:
         # ``sync=False`` lets a caller writing MANY files per logical
         # checkpoint (the sweep's per-member loop) batch the durability
@@ -109,6 +116,18 @@ class CorruptCheckpointError(ValueError):
     """A checkpoint whose bytes fail validation (checksum mismatch,
     truncation past the footer, undecodable msgpack) — damage, not an
     architecture mismatch."""
+
+
+class NonFiniteCheckpointError(ValueError):
+    """A checkpoint target carrying NaN/Inf float leaves. The write gate
+    (:func:`_write_atomic`) refuses to publish these: a diverged trainer
+    must never make a poisoned state visible to ``latest_checkpoint`` /
+    ``CheckpointDiscovery`` — the gate would reject it one candidate at
+    a time, resume would restore the divergence, and the recovery
+    ladder's rollback walk would find poison where it needs a last-good
+    state (train/recovery.py, docs/recovery.md). Callers degrade:
+    the async writer skips-with-audit, ``save_checkpoint`` returns
+    None."""
 
 
 def _with_footer(payload: bytes) -> bytes:
@@ -205,7 +224,44 @@ def msgpack_restore_file(path: str | Path, quarantine: bool = True) -> Any:
         raise err from e
 
 
-def _write_atomic(path: Path, target: Any) -> None:
+def nonfinite_leaf(target: Any) -> Optional[str]:
+    """Path of the first float leaf carrying NaN/Inf, or None when the
+    whole (host-side) tree is finite. The walk costs one pass over the
+    bytes — the same order as the crc32 the footer already pays. THE
+    one definition of the check — the write gate below, the chaos
+    invariant checker, and the trainer's run-end finiteness guarantee
+    all share it, so leaf-skipping and dtype rules can never drift."""
+    import jax
+    import numpy as np
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(target)[0]:
+        if isinstance(leaf, str) or leaf is None:
+            continue
+        try:
+            arr = np.asarray(leaf)
+        except (TypeError, ValueError):
+            continue  # non-numeric leaf (provenance metadata)
+        if np.issubdtype(arr.dtype, np.floating) and (
+            not np.isfinite(arr).all()
+        ):
+            return jax.tree_util.keystr(path)
+    return None
+
+
+def _audit_nonfinite_skip(path: Path, leaf: str) -> None:
+    """Counter + flight record for a write the non-finite gate refused —
+    a skipped checkpoint is a degradation, never silent."""
+    from marl_distributedformation_tpu.obs import get_registry, get_tracer
+
+    get_registry().counter("checkpoint_nonfinite_skipped_total").inc()
+    get_tracer().incident(
+        "checkpoint_nonfinite_skipped", path=str(path), leaf=leaf
+    )
+
+
+def _write_atomic(
+    path: Path, target: Any, check_finite: bool = True
+) -> None:
     import jax
 
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -217,6 +273,19 @@ def _write_atomic(path: Path, target: Any) -> None:
     # device->host round-trips can dominate the training loop (the
     # reference-parity save_freq checkpoints every iteration).
     target = jax.device_get(target)
+    # The non-finite write gate: a poisoned state must never become
+    # discoverable (the train-lane invariant chaos_storm --train pins).
+    # ``check_finite=False`` is for harnesses that deliberately forge a
+    # diverged file (the pipeline e2e's gate-sabotage fixture) — every
+    # production writer keeps the gate on.
+    bad = nonfinite_leaf(target) if check_finite else None
+    if bad is not None:
+        raise NonFiniteCheckpointError(
+            f"checkpoint {path.name}: leaf {bad} carries non-finite "
+            "values — refusing to publish a diverged state (the async "
+            "writer skips-with-audit; the recovery ladder owns the "
+            "rollback)"
+        )
     fault_point("checkpoint.write", path=tmp)
     tmp.write_bytes(_with_footer(serialization.to_bytes(target)))
     fault_point("checkpoint.pre_rename", path=tmp)
@@ -305,6 +374,8 @@ class AsyncCheckpointWriter:
         io_retries: int = 3,
         io_backoff_s: float = 0.05,
         rng: Optional[random.Random] = None,
+        keep_last_n: int = 0,
+        protect: Any = None,
     ) -> None:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
@@ -312,6 +383,14 @@ class AsyncCheckpointWriter:
         self.io_backoff_s = float(io_backoff_s)
         self.writes_skipped = 0
         self._rng = rng if rng is not None else random.Random()
+        # Retention ring (docs/recovery.md): after every successful
+        # ``submit`` write, keep only the newest ``keep_last_n``
+        # rl_model_* checkpoints in that file's directory (0 = keep
+        # everything, the legacy behavior). ``protect`` is a zero-arg
+        # callable returning paths that must survive pruning no matter
+        # their age — the trainer passes its last-good rollback target.
+        self.keep_last_n = max(0, int(keep_last_n))
+        self._protect = protect
 
     def submit(
         self, path: str | Path, target: Any, on_done: Any = None
@@ -331,6 +410,14 @@ class AsyncCheckpointWriter:
             _write_atomic(path, target)
             if on_done is not None:
                 on_done(path)
+            if self.keep_last_n > 0:
+                prune_checkpoints(
+                    path.parent,
+                    self.keep_last_n,
+                    protect=(
+                        self._protect() if self._protect is not None else ()
+                    ),
+                )
 
         self.submit_write(write)
         return path
@@ -386,6 +473,15 @@ class AsyncCheckpointWriter:
                     # simply lost (exactly what a real crash costs) —
                     # audit it and keep the training run alive.
                     self._skip(e)
+                    return
+                except NonFiniteCheckpointError as e:
+                    # The write gate refused a diverged state: skip with
+                    # the non-finite audit (its own counter + incident —
+                    # a poisoned snapshot is a TRAIN-lane event, not IO
+                    # weather) and keep training; the recovery ladder
+                    # owns the rollback.
+                    self.writes_skipped += 1
+                    _audit_nonfinite_skip(Path("<async>"), str(e))
                     return
             registry = get_registry()
             registry.histogram("checkpoint_write_seconds").observe(
@@ -451,7 +547,11 @@ def save_sweep_state(
     path = sweep_state_path(log_dir, num_timesteps)
     on_coordinator = is_coordinator()
     if on_coordinator:
-        _write_atomic(path, target)
+        try:
+            _write_atomic(path, target)
+        except NonFiniteCheckpointError as e:
+            _audit_nonfinite_skip(path, str(e))
+            path = None
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -481,6 +581,62 @@ def latest_checkpoint(log_dir: str | Path) -> Optional[Path]:
     """Find the checkpoint with the largest step number, exactly like the
     reference's discovery scan (visualize_policy.py:29-32)."""
     return _latest(log_dir, _STEP_RE)
+
+
+def prune_checkpoints(
+    log_dir: str | Path,
+    keep_last_n: int,
+    protect: Any = (),
+) -> List[Path]:
+    """Checkpoint retention ring: delete all but the newest
+    ``keep_last_n`` DISCOVERABLE ``rl_model_*`` checkpoints in
+    ``log_dir`` — a months-long always-learning run's unbounded
+    ``logs/{name}/`` growth is itself a robustness bug (the disk it
+    fills is the disk the next checkpoint needs).
+
+    Quarantine-aware by construction: only discoverable ``.msgpack``
+    files are candidates — ``*.quarantined`` evidence, torn ``.tmp``
+    files, ``sweep_state_*`` anchors, and the jsonl audit logs are
+    untouched. ``protect`` paths (the recovery ladder's CURRENT
+    last-good rollback target) survive no matter their age: pruning the
+    only state a rollback could restore would turn a divergence into a
+    halt. Best-effort (a prune failure is never worth a dead run);
+    returns the paths actually removed and counts them into
+    ``checkpoint_pruned_total``."""
+    keep_last_n = int(keep_last_n)
+    if keep_last_n <= 0:
+        return []
+    log_dir = Path(log_dir)
+    if not log_dir.is_dir():
+        return []
+    protected = {
+        Path(p).resolve() for p in (protect or ()) if p is not None
+    }
+    candidates = sorted(
+        (
+            p
+            for p in log_dir.iterdir()
+            if p.suffix == ".msgpack"
+            and not p.name.startswith(".")
+            and _STEP_RE.search(p.name)
+        ),
+        key=lambda p: int(_STEP_RE.search(p.name).group(1)),
+        reverse=True,
+    )
+    pruned: List[Path] = []
+    for path in candidates[keep_last_n:]:
+        if path.resolve() in protected:
+            continue
+        try:
+            path.unlink()
+        except OSError:
+            continue
+        pruned.append(path)
+    if pruned:
+        from marl_distributedformation_tpu.obs.metrics import get_registry
+
+        get_registry().counter("checkpoint_pruned_total").inc(len(pruned))
+    return pruned
 
 
 class CheckpointDiscovery:

@@ -340,7 +340,12 @@ def main(argv=None) -> dict:
             # footprint regressions vs the committed record; an older
             # record without the fields reports as sentinel_missing,
             # never a breach.
-            + obs_spine.ledger_watches(tolerance=sentinel_tol),
+            + obs_spine.ledger_watches(tolerance=sentinel_tol)
+            # Recovery-MTTR guard (train/recovery.py): live rollback
+            # restore wall vs the committed drill; wide band (recovery
+            # is rare, samples are few). Missing field = unmeasurable,
+            # never a breach.
+            + obs_spine.recovery_watches(),
             record_path=cfg.get("sentinel_bench"),
             trip_after=int(cfg.get("sentinel_trip_after", 3)),
             audit_dir=trainer.log_dir,
@@ -573,10 +578,21 @@ def main(argv=None) -> dict:
         for key in (
             "checkpoint_writes_skipped_total",
             "checkpoint_quarantined_total",
+            "checkpoint_nonfinite_skipped_total",
+            "checkpoint_pruned_total",
             "pipeline_gate_timeouts_total",
         ):
             if live.get(key):
                 report[key] = int(live[key])
+        # Self-healing train lane (train/recovery.py): surface the
+        # ladder's history in the run report — a supervised loop whose
+        # trainer quietly rolled back should SAY so.
+        if trainer.recovery_ladder is not None:
+            ladder = trainer.recovery_ladder
+            report["train_recoveries"] = ladder.recoveries
+            report["train_divergence_events"] = ladder.breaches
+            report["train_skipped_updates"] = ladder.skipped_total
+            report["train_halted"] = bool(trainer.halted)
         report["verified_served_steps"] = served_steps
         report["train_alive"] = train_thread.is_alive()
         if train_error:

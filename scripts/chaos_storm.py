@@ -83,6 +83,15 @@ MESH_SERVE_POINTS = (
     "mesh.rpc",
     "mesh.heartbeat",
 )
+# The --train campaign's divergence seams (train/recovery.py,
+# docs/recovery.md): carry poison / grad bombs at the dispatch
+# boundary plus checkpoint-time snapshot corruption, layered over the
+# write-path weather the PR-12 train leg already arms.
+TRAIN_LANE_POINTS = (
+    "train.carry_poison",
+    "train.grad_bomb",
+    "train.snapshot",
+)
 
 # Hit windows per point: high-frequency seams (polls, worker loops) can
 # absorb faults deep into the campaign; rare seams (one hit per commit
@@ -102,6 +111,13 @@ WINDOWS = {
     # continuously — same rare-vs-frequent split.
     "mesh.rpc": 4,
     "mesh.heartbeat": 12,
+    # train lane: the poison points hit once per dispatch and the
+    # snapshot point once per save — both frequent enough for mid-run
+    # windows, but each recovery REWINDS progress, so faults must land
+    # early enough that the rewound run still absorbs them all.
+    "train.carry_poison": 10,
+    "train.grad_bomb": 10,
+    "train.snapshot": 4,
 }
 
 
@@ -527,6 +543,208 @@ def run_campaign(
     return report
 
 
+def run_train_campaign(
+    seed: int = 0,
+    faults: int = 10,
+    workdir: Optional[str] = None,
+    budget_s: float = 240.0,
+    num_agents: int = 3,
+    num_formations: int = 4,
+    train_iterations: int = 40,
+    fused_chunk: int = 2,
+    mttr_bound_s: float = 60.0,
+) -> Dict[str, Any]:
+    """The storm pointed at the TRAIN lane (train/recovery.py,
+    docs/recovery.md): a fused-scan Trainer with the in-program health
+    word and the recovery ladder armed runs to completion while the
+    seeded schedule drives NaN carry bombs, finite grad bombs, and
+    checkpoint-time snapshot corruption through the dispatch boundary
+    (plus the PR-12 write-path weather). The campaign then checks the
+    lane's invariants: crash-consistent checkpoint dir, NO non-finite
+    checkpoint ever visible to discovery, the run terminated on finite
+    params without halting, recovery MTTR bounded, budget-1 compile
+    receipts with health + chaos both ON. One JSON line out."""
+    import tempfile
+
+    import numpy as np
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.chaos import (
+        Violation,
+        check_budget_one,
+        check_checkpoint_dir,
+        check_final_params_finite,
+        check_finite_checkpoints,
+        check_recovery_log,
+        get_fault_plane,
+        report_violations,
+    )
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.train import (
+        TrainConfig,
+        Trainer,
+        read_recovery_log,
+    )
+
+    t_start = time.perf_counter()
+    workdir = Path(
+        workdir
+        if workdir is not None
+        else tempfile.mkdtemp(prefix="chaos_train_")
+    )
+    log_dir = workdir / "run"
+    env = EnvParams(num_agents=num_agents, max_steps=20)
+    train_points = TRAIN_LANE_POINTS + TRAIN_POINTS
+    schedule = build_schedule(
+        seed,
+        faults,
+        point_names=train_points,
+    )
+    plane = get_fault_plane()
+    plane.reset()
+    report: Dict[str, Any] = {
+        "deterministic": {
+            "chaos_seed": int(seed),
+            "chaos_faults_armed": len(schedule),
+            "schedule": schedule.record(),
+        },
+    }
+    violations: List[Violation] = []
+
+    # One campaign leg: the REAL fused driver (dispatch N+1, drain N,
+    # detect at the drain, roll back, keep going) runs its whole budget
+    # under the armed schedule. Every rollback REWINDS num_timesteps, so
+    # the loop self-extends past each recovery — the hit windows above
+    # guarantee every fault lands well inside the budget.
+    per_iter = num_formations * num_agents * 5
+    max_rollbacks = max(8, faults)
+    trainer = Trainer(
+        env,
+        ppo=PPOConfig(n_steps=5, n_epochs=2, batch_size=32),
+        config=TrainConfig(
+            num_formations=num_formations,
+            total_timesteps=train_iterations * per_iter,
+            save_freq=5,
+            fused_chunk=fused_chunk,
+            name="chaos_train_storm",
+            log_dir=str(log_dir),
+            seed=0,
+            health=True,
+            recovery=True,
+            recovery_breach_iters=2,
+            recovery_max_rollbacks=max_rollbacks,
+            keep_last_n=6,
+        ),
+    )
+    plane.arm(schedule)
+    plane.enabled = True
+    try:
+        trainer.train()  # must SURVIVE every bomb and finish finite
+    finally:
+        # An escaping exception must not leave the PROCESS-GLOBAL plane
+        # live — anything running after this campaign (another leg, an
+        # embedding caller) would silently train under fault injection.
+        plane.enabled = False
+
+    # ---- invariants ----------------------------------------------------
+    fired = plane.fired_record()
+    unfired = plane.pending()
+    ladder = trainer.recovery_ladder
+    events = read_recovery_log(log_dir / "recovery.jsonl")
+    mttr = [
+        float(e["mttr_s"]) for e in events if e["event"] == "rollback"
+    ]
+    violations += check_checkpoint_dir(log_dir)
+    violations += check_finite_checkpoints(log_dir)
+    violations += check_recovery_log(
+        log_dir / "recovery.jsonl",
+        # +1: the run-end finite-params guarantee may restore once past
+        # the retry budget (Trainer._ensure_finite_final_state) — a
+        # legitimate terminal rollback, not a breached budget.
+        max_rollbacks=max_rollbacks + 1,
+        mttr_bound_s=mttr_bound_s,
+    )
+    violations += check_final_params_finite(
+        jax_device_get_params(trainer)
+    )
+    violations += check_budget_one(
+        {"train_iteration": trainer.retrace_guard.count}
+    )
+    if trainer.halted:
+        violations.append(
+            Violation(
+                "train_halt",
+                "the campaign's faults are all recoverable but the run "
+                "HALTED — the ladder burned its rollback budget on "
+                "faults it should have absorbed",
+            )
+        )
+    poison_fired = [
+        f for f in fired
+        if f["point"] in ("train.carry_poison", "train.grad_bomb")
+        and f["kind"] == "raise"
+    ]
+    if poison_fired and (ladder is None or ladder.recoveries == 0):
+        violations.append(
+            Violation(
+                "recovery",
+                f"{len(poison_fired)} poison fault(s) fired but the "
+                "ladder never rolled back — divergence went undetected",
+            )
+        )
+    if unfired:
+        violations.append(
+            Violation(
+                "campaign_coverage",
+                f"{unfired} armed fault(s) never fired — the campaign "
+                "ended before exercising its whole schedule (raise "
+                "train_iterations or lower the hit windows)",
+            )
+        )
+    report["chaos_violations"] = report_violations(violations, plane)
+    report["chaos_invariant_violations"] = len(violations)
+    report["chaos_faults_fired"] = len(fired)
+    report["chaos_faults_unfired"] = unfired
+    report["train_recoveries"] = ladder.recoveries if ladder else 0
+    report["train_divergence_events"] = ladder.breaches if ladder else 0
+    report["train_skipped_updates"] = (
+        ladder.skipped_total if ladder else 0
+    )
+    report["train_halted"] = bool(trainer.halted)
+    if mttr:
+        report["recovery_mttr_s"] = round(max(mttr), 3)
+        report["recovery_mttr_p50_s"] = round(
+            sorted(mttr)[len(mttr) // 2], 3
+        )
+    from marl_distributedformation_tpu.obs import get_registry
+
+    snap = get_registry().snapshot()
+    report["train_writes_skipped"] = int(
+        snap.get("checkpoint_writes_skipped_total", 0)
+    )
+    report["checkpoints_nonfinite_skipped"] = int(
+        snap.get("checkpoint_nonfinite_skipped_total", 0)
+    )
+    report["checkpoints_quarantined"] = int(
+        snap.get("checkpoint_quarantined_total", 0)
+    )
+    report["checkpoints_pruned"] = int(
+        snap.get("checkpoint_pruned_total", 0)
+    )
+    report["final_timesteps"] = int(trainer.num_timesteps)
+    report["campaign_seconds"] = round(time.perf_counter() - t_start, 2)
+    del budget_s  # the fused run is budget-bound by its iteration count
+    return report
+
+
+def jax_device_get_params(trainer):
+    """Host copy of the trainer's params (the final-finiteness
+    witness)."""
+    import jax
+
+    return jax.device_get(trainer.train_state.params)
+
+
 def run_mesh_campaign(
     seed: int = 0,
     faults: int = 20,
@@ -845,12 +1063,53 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="with --mesh: host subprocesses to spawn",
     )
     ap.add_argument(
+        "--train",
+        action="store_true",
+        help="point the storm at the TRAIN lane (train/recovery.py): "
+        "NaN carry bombs, finite grad bombs, and checkpoint-time "
+        "snapshot corruption through a live fused run with the health "
+        "word + recovery ladder armed; invariants: crash-consistent "
+        "dir, no non-finite checkpoint visible, finite finish, bounded "
+        "MTTR, budget-1 receipts",
+    )
+    ap.add_argument(
         "--print-schedule",
         action="store_true",
         help="emit the armed fault schedule (deterministic from the "
         "seed) and exit without running anything",
     )
     args = ap.parse_args(argv)
+    if args.mesh and args.train:
+        ap.error("--mesh and --train are separate campaigns; pick one")
+    if args.train:
+        train_faults = min(args.faults, 14)
+        if train_faults < args.faults:
+            print(
+                f"[storm] --train caps --faults at 14 (requested "
+                f"{args.faults}): the train lane's armable cells are "
+                "bounded by the hit windows",
+                file=sys.stderr,
+            )
+        if args.print_schedule:
+            schedule = build_schedule(
+                args.seed,
+                train_faults,
+                point_names=TRAIN_LANE_POINTS + TRAIN_POINTS,
+            )
+            print(json.dumps({
+                "chaos_seed": args.seed,
+                "chaos_faults_armed": len(schedule),
+                "schedule": schedule.record(),
+            }))
+            return 0
+        report = run_train_campaign(
+            seed=args.seed,
+            faults=train_faults,
+            workdir=args.workdir,
+            budget_s=args.budget_s,
+        )
+        print(json.dumps(report))
+        return 0 if report.get("chaos_invariant_violations") == 0 else 1
     mesh_faults = min(args.faults, 20) if args.mesh else args.faults
     if args.mesh and mesh_faults < args.faults:
         print(

@@ -347,6 +347,63 @@ def _chaos_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _recovery_problems(rec: dict) -> list[str]:
+    """Structural validation of the train-lane recovery fields (bench
+    phase 15), whenever present: the in-program health word's overhead
+    must be a finite number under the 5% bar (it is a handful of
+    reductions + selects fused into a program that already runs a full
+    PPO update), recovery MTTR a finite positive number (zero means no
+    divergence was actually recovered from), and the drill's divergence
+    count >= 1 (the bench INJECTS a bomb — a zero count is a broken
+    detector, not a clean run). ``"skipped"`` sentinels are honored as
+    structurally absent."""
+    problems = []
+    overhead = _present(rec, "health_overhead_pct")
+    if overhead is not None:
+        try:
+            v = float(overhead)
+            if not math.isfinite(v):
+                problems.append(
+                    f"health_overhead_pct not finite: {overhead!r}"
+                )
+            elif v >= 5.0:
+                problems.append(
+                    f"health_overhead_pct={v} breaches the 5% bar — "
+                    "the health word must stay a few fused reductions "
+                    "and selects, not a program of its own"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"health_overhead_pct is not a number: {overhead!r}"
+            )
+    mttr = _present(rec, "recovery_mttr_s")
+    if mttr is not None:
+        try:
+            v = float(mttr)
+            if not math.isfinite(v) or v <= 0.0:
+                problems.append(
+                    f"recovery_mttr_s={mttr!r} (need a finite number "
+                    "> 0: zero means the drill's bomb was never "
+                    "recovered from)"
+                )
+        except (TypeError, ValueError):
+            problems.append(f"recovery_mttr_s is not a number: {mttr!r}")
+    events = _present(rec, "train_divergence_events")
+    if events is not None:
+        try:
+            if int(events) < 1:
+                problems.append(
+                    f"train_divergence_events={events!r} — the drill "
+                    "injects a bomb, so a measured run must detect at "
+                    "least one sustained breach"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"train_divergence_events is not an int: {events!r}"
+            )
+    return problems
+
+
 def _ledger_problems(rec: dict) -> list[str]:
     """Structural validation of the program-ledger fields (bench phase
     13), whenever present: the enabled-ledger overhead must be a finite
@@ -591,6 +648,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_serving_slo_problems(rec))
     problems.extend(_adversarial_problems(rec))
     problems.extend(_chaos_problems(rec))
+    problems.extend(_recovery_problems(rec))
     problems.extend(_ledger_problems(rec))
     problems.extend(_mesh_problems(rec))
     for field in require:

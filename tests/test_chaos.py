@@ -550,3 +550,52 @@ def test_chaos_storm_campaign_zero_violations(tmp_path):
     kinds = {f["kind"] for f in expected.record()}
     assert {"crash", "wedge", "enospc", "delay"} <= kinds
     assert kinds & {"truncate", "bitflip"}  # corrupt coverage
+
+
+def test_chaos_storm_train_campaign_zero_violations(tmp_path):
+    """The --train storm (ISSUE 15): a live fused run with the health
+    word + recovery ladder armed absorbs NaN carry bombs / grad bombs /
+    snapshot corruption plus the PR-12 write-path weather — every fault
+    fires, zero invariant violations (crash consistency, NO non-finite
+    checkpoint visible, finite finish without halting, bounded MTTR,
+    budget-1 receipts), and the deterministic report section equals the
+    seed's pure-function schedule (the one-JSON-line contract)."""
+    import pathlib
+    import sys
+
+    scripts = pathlib.Path(__file__).resolve().parent.parent / "scripts"
+    sys.path.insert(0, str(scripts))
+    try:
+        from chaos_storm import (
+            TRAIN_LANE_POINTS,
+            TRAIN_POINTS,
+            build_schedule,
+            run_train_campaign,
+        )
+    finally:
+        sys.path.pop(0)
+
+    plane = get_fault_plane()
+    try:
+        report = run_train_campaign(
+            seed=2, faults=10, workdir=str(tmp_path)
+        )
+    finally:
+        plane.enabled = False
+        plane.reset()
+    assert report["chaos_invariant_violations"] == 0, report.get(
+        "chaos_violations"
+    )
+    assert report["chaos_faults_fired"] == 10
+    assert report["chaos_faults_unfired"] == 0
+    assert not report["train_halted"]
+    assert report["train_recoveries"] >= 1  # seed 2 arms poison raises
+    assert 0.0 < report["recovery_mttr_s"] < 60.0
+    expected = build_schedule(
+        2, 10, point_names=TRAIN_LANE_POINTS + TRAIN_POINTS
+    )
+    assert report["deterministic"] == {
+        "chaos_seed": 2,
+        "chaos_faults_armed": 10,
+        "schedule": expected.record(),
+    }

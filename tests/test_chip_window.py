@@ -427,6 +427,44 @@ def test_check_bench_record_gates():
         },
         [], [],
     ) == []
+    # Train-lane recovery fields (bench phase 15), validated whenever
+    # present: health-word overhead finite under the 5% bar (negative
+    # legitimate — interleave noise), recovery MTTR finite > 0, the
+    # drill's divergence count >= 1 (the bench injects a bomb; zero
+    # means the detector is broken), "skipped" sentinels honored.
+    recovery_ok = {
+        **clean,
+        "health_overhead_pct": 0.7,
+        "recovery_mttr_s": 0.21,
+        "train_divergence_events": 1,
+    }
+    assert check(recovery_ok, [], []) == []
+    assert check(
+        {**recovery_ok, "health_overhead_pct": -0.2}, [], []
+    ) == []
+    assert check({**recovery_ok, "health_overhead_pct": 6.2}, [], [])
+    assert check(
+        {**recovery_ok, "health_overhead_pct": float("nan")}, [], []
+    )
+    assert check({**recovery_ok, "health_overhead_pct": "cheap"}, [], [])
+    assert check({**recovery_ok, "recovery_mttr_s": 0.0}, [], [])
+    assert check(
+        {**recovery_ok, "recovery_mttr_s": float("inf")}, [], []
+    )
+    assert check({**recovery_ok, "recovery_mttr_s": "fast"}, [], [])
+    assert check({**recovery_ok, "train_divergence_events": 0}, [], [])
+    assert check(
+        {**recovery_ok, "train_divergence_events": "some"}, [], []
+    )
+    assert check(
+        {
+            **clean,
+            "health_overhead_pct": "skipped",
+            "recovery_mttr_s": "skipped",
+            "train_divergence_events": "skipped",
+        },
+        [], [],
+    ) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

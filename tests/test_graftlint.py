@@ -1179,6 +1179,73 @@ FIXTURES = [
             return carry, stacked
         """,
     ),
+    (
+        # Rule 22: per-iteration host finiteness polling of a device
+        # value forces one sync per dispatch (and sees fused divergence
+        # K iterations late). The good twin computes the health word
+        # in-program and drains it batched — np over the DRAINED numpy
+        # stack is the legitimate spelling.
+        "host-nonfinite-probe-in-dispatch-loop",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def train(step, state, total):
+            i = 0
+            while i < total:
+                state, loss = step(state)
+                if jnp.isnan(loss).any():  # device sync per iteration
+                    break
+                i += 1
+            return state
+        """,
+        """
+        import jax
+        import numpy as np
+
+        def train(step_chunk, state, chunks):
+            stacks = []
+            for _ in range(chunks):
+                state, stacked = step_chunk(state)  # health word rides
+                stacks.append(stacked)              # the chunk metrics
+            drained = jax.device_get(stacks)  # ONE batched drain
+            flags = np.concatenate([s["health_ok"] for s in drained])
+            skipped = int((flags < 0.5).sum())  # np over host data: clean
+            return state, skipped
+        """,
+    ),
+    (
+        # Same hazard spelled as float()-pull probes — math.isnan over
+        # a forced transfer, one hop into a helper — in a for-loop
+        # dispatch body. The good twin keeps the float() pulls (the
+        # drain's legitimate log path) but probes finiteness only once,
+        # AFTER the loop.
+        "host-nonfinite-probe-in-dispatch-loop",
+        """
+        import math
+
+        def diverged(metrics):
+            return math.isnan(float(metrics["loss"]))
+
+        def train(step, state, total):
+            for _ in range(total):
+                state, metrics = step(state)
+                if diverged(metrics):  # reaches math.isnan(float(...))
+                    break
+            return state
+        """,
+        """
+        import math
+
+        def train(step, state, total):
+            record = {}
+            for _ in range(total):
+                state, metrics = step(state)
+                record = {k: float(v) for k, v in metrics.items()}
+            final_ok = not math.isnan(float(record["loss"]))  # once, post-loop
+            return state, final_ok
+        """,
+    ),
 ]
 
 

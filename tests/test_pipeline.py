@@ -417,7 +417,11 @@ def _sabotage_nan(path):
         else x,
         raw["params"],
     )
-    _write_atomic(path, raw)
+    # check_finite=False: production writers can no longer publish a
+    # non-finite state (the train-lane write gate, docs/recovery.md) —
+    # this fixture deliberately forges one to prove the GATE still
+    # rejects it at eval time (defense in depth one layer up).
+    _write_atomic(path, raw, check_finite=False)
 
 
 def test_reload_pinned_demotes_backward(tmp_path):

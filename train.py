@@ -75,6 +75,22 @@ def train_config_from_config(cfg) -> TrainConfig:
         guard_retraces=int(cfg.get("guard_retraces", 0)),
         guard_transfers=bool(cfg.get("guard_transfers", False)),
         guard_nans=bool(cfg.get("guard_nans", False)),
+        # Self-healing train lane (train/recovery.py, docs/recovery.md):
+        # in-program health word + skip guard, the host-side escalation
+        # ladder, and the checkpoint retention ring.
+        health=bool(cfg.get("health", False)),
+        health_grad_norm_max=float(cfg.get("health_grad_norm_max", 1.0e6)),
+        health_param_drift_max=float(
+            cfg.get("health_param_drift_max", 10.0)
+        ),
+        recovery=bool(cfg.get("recovery", False)),
+        recovery_breach_iters=int(cfg.get("recovery_breach_iters", 3)),
+        recovery_max_rollbacks=int(cfg.get("recovery_max_rollbacks", 3)),
+        recovery_lr_backoff=float(cfg.get("recovery_lr_backoff", 1.0)),
+        recovery_severity_backoff=float(
+            cfg.get("recovery_severity_backoff", 1.0)
+        ),
+        keep_last_n=int(cfg.get("keep_last_n", 0)),
     )
 
 
