@@ -92,6 +92,13 @@ Measures, inside one process and one JSON line:
   detection-at-drain -> rollback wall clock from recovery.jsonl, and
   the ladder's sustained-breach count (>= 1 or the detector is
   broken).
+- ``graftlint_wall_s``: one full ``scripts/graftlint.py --check`` pass
+  over the package (pure-AST, subprocess — the exact CI invocation).
+  The call-graph engine rebuilds its whole-repo graph from a cold
+  process, so this wall is the worst-case lint cost a pre-commit hook
+  pays; check_bench_record.py holds it under a ceiling so the
+  whole-package analyses (lock-ordering cycles, guarded-write DFS)
+  cannot quietly go super-linear as the repo grows.
 
 Phases skipped via
   ``BENCH_SKIP_*`` env vars record the explicit ``"skipped"`` sentinel
@@ -126,7 +133,7 @@ BENCH_TELEMETRY_PASSES, BENCH_SENTINEL_CHECKS, BENCH_SKIP_CHAOS=1,
 BENCH_CHAOS_SEED, BENCH_CHAOS_FAULTS, BENCH_LEDGER_CHUNK,
 BENCH_LEDGER_PASSES (the ledger phase shares BENCH_SKIP_TRAIN),
 BENCH_SKIP_MESH=1, BENCH_MESH_HOSTS, BENCH_MESH_DURATION_S,
-BENCH_MESH_SWAPS.
+BENCH_MESH_SWAPS, BENCH_SKIP_LINT=1, BENCH_LINT_TIMEOUT_S.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -2304,6 +2311,56 @@ def main() -> None:
                 notes.append(f"recovery phase failed: {e!r}"[:200])
         else:
             notes.append("recovery phase skipped: deadline")
+
+        # --- Phase 16: graftlint wall (scripts/graftlint.py,
+        # analysis/callgraph.py, docs/static_analysis.md). One full
+        # --check pass over the package in a fresh subprocess — the
+        # exact CI invocation, so the wall includes the cold-process
+        # whole-repo call-graph rebuild (the worst case a pre-commit
+        # hook pays). check_bench_record.py holds the field under a
+        # ceiling: the lock-ordering / guarded-write analyses are
+        # package-global DFS walks and must not go super-linear as the
+        # repo grows. A non-zero lint exit is a note, not a crash —
+        # the bench record must still emit on a dirty tree.
+        if os.environ.get("BENCH_SKIP_LINT") == "1":
+            _mark_skipped(result, "lint", ("graftlint_wall_s",))
+        elif time.time() < deadline - 10:
+            import pathlib
+
+            lint_cmd = [
+                sys.executable,
+                str(
+                    pathlib.Path(__file__).resolve().parent
+                    / "scripts"
+                    / "graftlint.py"
+                ),
+                "--check",
+            ]
+            lint_timeout = _env_int("BENCH_LINT_TIMEOUT_S", 300)
+            t0 = time.perf_counter()
+            try:
+                lint = subprocess.run(
+                    lint_cmd, capture_output=True, text=True,
+                    timeout=lint_timeout,
+                )
+            except subprocess.TimeoutExpired:
+                notes.append(
+                    f"graftlint timed out after {lint_timeout}s"
+                )
+            else:
+                result["graftlint_wall_s"] = round(
+                    time.perf_counter() - t0, 3
+                )
+                if lint.returncode != 0:
+                    notes.append("graftlint --check found errors")
+                print(
+                    "[bench] graftlint --check: "
+                    f"{result['graftlint_wall_s']}s wall "
+                    f"(exit {lint.returncode})",
+                    file=sys.stderr,
+                )
+        else:
+            notes.append("lint phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

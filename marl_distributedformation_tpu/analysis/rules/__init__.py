@@ -28,6 +28,12 @@ from marl_distributedformation_tpu.analysis.rules.f64_promotion import (
 from marl_distributedformation_tpu.analysis.rules.fault_scope import (
     FaultPointInTracedScope,
 )
+from marl_distributedformation_tpu.analysis.rules.graftlock import (
+    BlockingCallUnderDispatchLock,
+    LockOrderingCycle,
+    LockReleasedAcrossAwaitSeam,
+    UnguardedSharedMutation,
+)
 from marl_distributedformation_tpu.analysis.rules.host_sync import HostSyncInJit
 from marl_distributedformation_tpu.analysis.rules.ledger_scope import (
     LedgerRecordInTracedScope,
@@ -83,6 +89,10 @@ RULES = (
     LedgerRecordInTracedScope(),
     RpcInTracedScope(),
     HostNonfiniteProbeInDispatchLoop(),
+    LockOrderingCycle(),
+    UnguardedSharedMutation(),
+    BlockingCallUnderDispatchLock(),
+    LockReleasedAcrossAwaitSeam(),
 )
 
 

@@ -9,9 +9,12 @@ fusion exists to remove (train/trainer.py drains telemetry as stacked
 scan outputs in ONE batched ``device_get`` per chunk instead). Outside a
 loop body the same callbacks cost one transfer per dispatch and are
 legitimate debugging tools, so this rule fires only where a compiled
-loop multiplies them. Reachability is checked one call hop deep: a
-loop body calling a same-module helper that performs the callback is
-the same hazard wearing a function name.
+loop multiplies them. Reachability runs on the shared call graph
+(``analysis/callgraph.py``): a loop body calling into a chain of
+same-module helpers or methods that performs the callback is the same
+hazard wearing function names, followed to the engine's depth bound.
+Chains that ENTER through an import are rule 14's report — the two
+rules split on the first hop so a finding has exactly one owner.
 """
 
 from __future__ import annotations
@@ -19,11 +22,19 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from marl_distributedformation_tpu.analysis import callgraph
 from marl_distributedformation_tpu.analysis.linter import (
     ModuleContext,
     Rule,
     dotted_name,
 )
+
+# First-hop kinds this rule owns; import-entered chains are rule 14's.
+_LOCAL_HOPS = frozenset({"local", "method"})
+
+
+def _callback_pred(node: ast.Call, fname) -> Optional[str]:
+    return fname if fname in _CALLBACK_CALLS else None
 
 # Compiled-loop entry points -> positions of the body callables among the
 # positional args (the loop subset of linter.TRACING_ENTRY_ARGS: vmap/jit
@@ -106,29 +117,18 @@ class CallbackInHotLoop(Rule):
                     "values into the scan output and drain them once per "
                     "chunk instead",
                 )
-            elif isinstance(node.func, ast.Name):
-                callee = self._callback_in_callee(ctx, node.func.id)
-                if callee:
+            else:
+                hit = callgraph.reachable_call(
+                    ctx, node, _callback_pred, first_hops=_LOCAL_HOPS
+                )
+                if hit is not None:
+                    called = dotted_name(node.func) or "<callable>"
                     yield (
                         node.lineno,
                         node.col_offset,
-                        f"{node.func.id}() is called from a compiled "
-                        f"loop body and reaches {callee}(...) — a host "
-                        "callback every scanned iteration; hoist it out "
-                        "of the loop or stack values into the scan "
+                        f"{called}() is called from a compiled "
+                        f"loop body and reaches {hit.matched}(...) — a "
+                        "host callback every scanned iteration; hoist it "
+                        "out of the loop or stack values into the scan "
                         "output",
                     )
-
-    @staticmethod
-    def _callback_in_callee(ctx: ModuleContext, name: str) -> Optional[str]:
-        """One-hop reachability: does a same-module function ``name``
-        perform a host callback? (Deeper chains and cross-module calls
-        are out of scope for a per-file AST pass — the runtime transfer
-        guard covers those.)"""
-        for definition in ctx._defs_by_name.get(name, ()):
-            for node in ast.walk(definition):
-                if isinstance(node, ast.Call):
-                    fname = dotted_name(node.func)
-                    if fname in _CALLBACK_CALLS:
-                        return fname
-        return None

@@ -1,28 +1,28 @@
 """cross-module-callback: host callbacks hidden behind imported helpers.
 
-Rule 12 (``callback-in-hot-loop``) resolves one call hop INSIDE the
-linted module: a ``lax.scan`` body calling a same-module helper that
-performs ``io_callback``/``jax.debug.print`` is caught. The same hazard
-wearing an import — ``from telemetry import emit`` (or ``import
+Rule 12 (``callback-in-hot-loop``) owns chains that START inside the
+linted module: a ``lax.scan`` body calling a same-module helper (or
+method) that performs ``io_callback``/``jax.debug.print``. The same
+hazard wearing an import — ``from telemetry import emit`` (or ``import
 telemetry; telemetry.emit(...)``) with the callback inside the imported
-helper — was invisible to a strictly per-file pass. This rule closes
-that hop: when a compiled loop body calls an imported name, the
-imported module is located on disk (relative imports resolve against
-the linted file; absolute imports search the file's ancestor
-directories, which covers both sibling-module scripts and package
-roots), parsed once (mtime-keyed cache), and the helper's own body is
-scanned for direct callback calls. Still exactly one hop — a chain of
-two imported helpers is out of scope for an AST pass and left to the
-runtime transfer guard — and unresolvable modules (site-packages,
-generated code) stay silent rather than guessing.
+helper — is this rule's report. Resolution and traversal run on the
+shared call-graph engine (``analysis/callgraph.py``), which owns the
+mtime-keyed cross-module parse cache this rule originally grew:
+relative imports resolve against the linted file, absolute imports
+search the file's ancestor directories, and the chain is followed
+transitively to the engine's depth bound (an imported helper calling a
+second helper — in its own module or back through another import — is
+the same host round trip one more name away). Unresolvable modules
+(site-packages, generated code) stay silent rather than guessing.
 """
 
 from __future__ import annotations
 
 import ast
 from pathlib import Path
-from typing import Dict, Iterator, Optional, Set, Tuple
+from typing import Iterator, Optional, Set, Tuple
 
+from marl_distributedformation_tpu.analysis import callgraph
 from marl_distributedformation_tpu.analysis.linter import (
     ModuleContext,
     Rule,
@@ -33,10 +33,11 @@ from marl_distributedformation_tpu.analysis.rules.callbacks import (
     CallbackInHotLoop,
 )
 
-# How many ancestor directories of the linted file are searched as
-# roots for absolute imports. Covers a package nested a few levels deep
-# without walking to the filesystem root on every unresolvable import.
-_MAX_ROOT_WALK = 6
+_IMPORT_HOPS = frozenset({"import"})
+
+
+def _callback_pred(node: ast.Call, fname) -> Optional[str]:
+    return fname if fname in _CALLBACK_CALLS else None
 
 
 class CrossModuleCallback(Rule):
@@ -48,184 +49,27 @@ class CrossModuleCallback(Rule):
         "round trip every scanned iteration, hidden one import away"
     )
 
-    # Parsed-module cache shared across files and lint runs, keyed on
-    # (path, mtime_ns) — rules are singletons (rules/__init__.py), so
-    # a package-wide scan parses each imported module at most once.
-    _tree_cache: Dict[Tuple[str, int], Optional[ast.Module]] = {}
-
     def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
-        from_imports, module_aliases = self._imports(ctx.tree)
-        if not from_imports and not module_aliases:
-            return
         reported: Set[Tuple[int, int]] = set()
         for body in CallbackInHotLoop._loop_bodies(ctx):
             for node in ast.walk(body):
                 if not isinstance(node, ast.Call):
                     continue
-                hit = self._resolve_call(
-                    ctx, node, from_imports, module_aliases
+                if dotted_name(node.func) in _CALLBACK_CALLS:
+                    continue  # direct callbacks are rule 12's finding
+                hit = callgraph.reachable_call(
+                    ctx, node, _callback_pred, first_hops=_IMPORT_HOPS
                 )
                 if hit and (node.lineno, node.col_offset) not in reported:
                     reported.add((node.lineno, node.col_offset))
-                    called, module, callback = hit
+                    called = dotted_name(node.func) or "<callable>"
+                    module = Path(hit.first_module).stem
                     yield (
                         node.lineno,
                         node.col_offset,
                         f"{called}() is called from a compiled loop body "
-                        f"and reaches {callback}(...) in imported module "
-                        f"{module!r} — a host callback every scanned "
-                        "iteration; hoist it out of the loop or stack "
-                        "values into the scan output",
+                        f"and reaches {hit.matched}(...) in imported "
+                        f"module {module!r} — a host callback every "
+                        "scanned iteration; hoist it out of the loop or "
+                        "stack values into the scan output",
                     )
-
-    # -- import surface ---------------------------------------------------
-
-    @staticmethod
-    def _imports(
-        tree: ast.Module,
-    ) -> Tuple[Dict[str, Tuple[str, str, int]], Dict[str, Tuple[str, int]]]:
-        """``from_imports[local] = (module, attr, level)`` for
-        ``from module import attr as local``;
-        ``module_aliases[alias] = (module, 0)`` for
-        ``import module [as alias]`` (a dotted ``import a.b`` binds the
-        full dotted path — usage is ``a.b.f``)."""
-        from_imports: Dict[str, Tuple[str, str, int]] = {}
-        module_aliases: Dict[str, Tuple[str, int]] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ImportFrom):
-                module = node.module or ""
-                for alias in node.names:
-                    if alias.name == "*":
-                        continue
-                    local = alias.asname or alias.name
-                    from_imports[local] = (module, alias.name, node.level)
-            elif isinstance(node, ast.Import):
-                for alias in node.names:
-                    if alias.asname:
-                        module_aliases[alias.asname] = (alias.name, 0)
-                    else:
-                        module_aliases[alias.name] = (alias.name, 0)
-        return from_imports, module_aliases
-
-    # -- call resolution --------------------------------------------------
-
-    def _resolve_call(
-        self,
-        ctx: ModuleContext,
-        node: ast.Call,
-        from_imports: Dict[str, Tuple[str, str, int]],
-        module_aliases: Dict[str, Tuple[str, int]],
-    ) -> Optional[Tuple[str, str, str]]:
-        """``(called_name, module, callback)`` when this call reaches an
-        imported helper that performs a host callback; else None."""
-        if isinstance(node.func, ast.Name):
-            name = node.func.id
-            if name in ctx._defs_by_name:
-                return None  # same-module def shadows: rule 12's domain
-            imported = from_imports.get(name)
-            if imported is None:
-                return None
-            module, attr, level = imported
-            callback = self._callback_in_module_func(
-                ctx.path, module, attr, level
-            )
-            if callback:
-                return name, module or "." * level, callback
-            return None
-        fname = dotted_name(node.func)
-        if not fname or "." not in fname:
-            return None
-        if fname in _CALLBACK_CALLS:
-            return None  # direct callbacks are rule 12's finding
-        prefix, _, attr = fname.rpartition(".")
-        # `import pkg.mod` / `import pkg.mod as m` usage: m.f(...)
-        aliased = module_aliases.get(prefix)
-        if aliased is not None:
-            module, level = aliased
-            callback = self._callback_in_module_func(
-                ctx.path, module, attr, level
-            )
-            if callback:
-                return fname, module, callback
-            return None
-        # `from pkg import mod` usage: mod.f(...) — the imported name is
-        # itself a module.
-        head, _, rest = prefix.partition(".")
-        imported = from_imports.get(head)
-        if imported is not None and not rest:
-            module, sub, level = imported
-            full = f"{module}.{sub}" if module else sub
-            callback = self._callback_in_module_func(
-                ctx.path, full, attr, level
-            )
-            if callback:
-                return fname, full, callback
-        return None
-
-    # -- module file resolution + scan ------------------------------------
-
-    def _callback_in_module_func(
-        self, path: str, module: str, func: str, level: int
-    ) -> Optional[str]:
-        """Does top-level function ``func`` of ``module`` (resolved
-        relative to the linted file at ``path``) directly perform a host
-        callback? One hop only; unresolvable modules answer no."""
-        tree = self._module_tree(path, module, level)
-        if tree is None:
-            return None
-        for node in tree.body:
-            if (
-                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name == func
-            ):
-                for inner in ast.walk(node):
-                    if isinstance(inner, ast.Call):
-                        fname = dotted_name(inner.func)
-                        if fname in _CALLBACK_CALLS:
-                            return fname
-        return None
-
-    def _module_tree(
-        self, path: str, module: str, level: int
-    ) -> Optional[ast.Module]:
-        file = self._module_file(path, module, level)
-        if file is None:
-            return None
-        try:
-            key = (str(file), file.stat().st_mtime_ns)
-        except OSError:
-            return None
-        if key not in self._tree_cache:
-            try:
-                tree: Optional[ast.Module] = ast.parse(
-                    file.read_text(encoding="utf-8")
-                )
-            except (OSError, SyntaxError, UnicodeDecodeError):
-                tree = None
-            self._tree_cache[key] = tree
-        return self._tree_cache[key]
-
-    @staticmethod
-    def _module_file(
-        path: str, module: str, level: int
-    ) -> Optional[Path]:
-        base = Path(path).resolve().parent
-        parts = module.split(".") if module else []
-        if level > 0:
-            # Relative import: `from .helpers import f` resolves against
-            # the linted file's package, one parent per extra dot.
-            root = base
-            for _ in range(level - 1):
-                root = root.parent
-            roots = [root]
-        else:
-            roots = [base, *list(base.parents)[:_MAX_ROOT_WALK]]
-        for root in roots:
-            if parts:
-                as_module = root.joinpath(*parts).with_suffix(".py")
-                if as_module.is_file():
-                    return as_module
-                as_package = root.joinpath(*parts, "__init__.py")
-                if as_package.is_file():
-                    return as_package
-        return None

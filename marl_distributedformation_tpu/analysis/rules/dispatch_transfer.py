@@ -15,10 +15,15 @@ placement to the swap/commit seam (``ModelRegistry.refresh``,
 device-resident buffers.
 
 Scope, deliberately: ``jax.device_put``/``device_put`` calls inside a
-host-side ``while``-loop body — directly, or one plain-name call hop
-into a same-module helper (rule 12's reachability precedent; method
-attributes and cross-module calls are left to the runtime
-``no_host_transfers`` guard). ``device_get`` is NOT this rule's
+host-side ``while``-loop body — directly, or through a chain of
+plain-name helpers (same-module or imported) followed on the shared
+call graph to its depth bound. METHOD calls are deliberately not
+followed: the sanctioned placement homes in this repo are methods
+(``ModelRegistry.refresh``, ``FleetReloadCoordinator._load_and_commit``)
+invoked from poll loops at swap frequency, and following
+``self.refresh()`` would flag exactly the once-per-swap seam the rule
+exists to protect; the runtime ``no_host_transfers`` guard covers
+per-request method paths. ``device_get`` is NOT this rule's
 business: the trainer's host loop legitimately drains telemetry with
 one amortized batched ``device_get`` per log interval, and policing
 gets statically would flag exactly that idiom. Loops inside traced
@@ -30,6 +35,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator, List, Optional, Set, Tuple
 
+from marl_distributedformation_tpu.analysis import callgraph
 from marl_distributedformation_tpu.analysis.linter import (
     ModuleContext,
     Rule,
@@ -37,6 +43,11 @@ from marl_distributedformation_tpu.analysis.linter import (
 )
 
 _TRANSFER_CALLS = frozenset({"jax.device_put", "device_put"})
+_NAME_HOPS = frozenset({"local", "import"})
+
+
+def _transfer_pred(node: ast.Call, fname) -> Optional[str]:
+    return fname if fname in _TRANSFER_CALLS else None
 
 
 class DevicePutInDispatchLoop(Rule):
@@ -84,29 +95,16 @@ class DevicePutInDispatchLoop(Rule):
                     "device-resident buffers per dispatch",
                 )
             elif isinstance(node.func, ast.Name):
-                callee = self._transfer_in_callee(ctx, node.func.id)
-                if callee:
+                hit = callgraph.reachable_call(
+                    ctx, node, _transfer_pred, first_hops=_NAME_HOPS
+                )
+                if hit is not None:
                     yield (
                         node.lineno,
                         node.col_offset,
                         f"{node.func.id}() is called from a dispatch "
-                        f"loop and reaches {callee}(...) — a "
+                        f"loop and reaches {hit.matched}(...) — a "
                         "host->device upload every iteration; hoist the "
                         "placement out of the loop to the swap/commit "
                         "seam",
                     )
-
-    @staticmethod
-    def _transfer_in_callee(
-        ctx: ModuleContext, name: str
-    ) -> Optional[str]:
-        """One-hop reachability through a same-module plain-name helper
-        (rule 12's precedent: deeper chains, methods, and cross-module
-        calls belong to the runtime transfer guard)."""
-        for definition in ctx._defs_by_name.get(name, ()):
-            for node in ast.walk(definition):
-                if isinstance(node, ast.Call):
-                    fname = dotted_name(node.func)
-                    if fname in _TRANSFER_CALLS:
-                        return fname
-        return None

@@ -33,8 +33,11 @@ this rule polices are host loops):
 - ``math.isnan(float(x))`` / ``np.isfinite(float(x))`` style probes —
   the ``float()`` call IS the forced transfer, the finiteness wrapper
   marks it as a divergence poll;
-- one plain-name call hop into a same-module helper that probes (the
-  rule 12/16 reachability precedent).
+- a plain-name call into a helper chain that probes, followed on the
+  shared call graph (``analysis/callgraph.py``) to its depth bound.
+  Callees that are themselves traced scopes are pruned: a traced
+  helper's ``jnp.isnan`` is the in-program health word — the sanctioned
+  replacement, not the hazard.
 
 What stays CLEAN, deliberately: ``np.isfinite`` over already-drained
 numpy arrays (the drain seam's legitimate batched check), ``float(v)``
@@ -48,6 +51,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator, List, Optional, Set, Tuple
 
+from marl_distributedformation_tpu.analysis import callgraph
 from marl_distributedformation_tpu.analysis.linter import (
     ModuleContext,
     Rule,
@@ -74,6 +78,17 @@ def _host_probe_name(fname: Optional[str]) -> bool:
         return False
     root, attr = fname.rsplit(".", 1)
     return attr in _PROBE_ATTRS and root in _HOST_ROOTS
+
+
+def _probe_pred(node: ast.Call, fname) -> "str | None":
+    """Call-graph predicate: is this call site a host finiteness probe?
+    (jnp spellings anywhere; math/np spellings only over a float()/
+    .item() pull — see the module docstring.)"""
+    if _jnp_probe_name(fname):
+        return f"{fname}(...)"
+    if _host_probe_name(fname) and _has_float_extraction(node):
+        return f"{fname}(float(...))"
+    return None
 
 
 def _has_float_extraction(node: ast.Call) -> bool:
@@ -168,18 +183,17 @@ class HostNonfiniteProbeInDispatchLoop(Rule):
             return f"{fname}(...) (from jax.numpy)"
         if _host_probe_name(fname) and _has_float_extraction(node):
             return f"{fname}(float(...))"
-        # One plain-name hop into a same-module helper (rule 12/16's
-        # reachability precedent; methods and cross-module calls are
-        # the runtime transfer guard's business).
+        # Transitive plain-name chains on the shared call graph; traced
+        # callees are pruned — their probes are the in-program health
+        # word, i.e. the fix, not the hazard.
         if isinstance(node.func, ast.Name):
-            for definition in ctx._defs_by_name.get(node.func.id, ()):
-                for inner in ast.walk(definition):
-                    if not isinstance(inner, ast.Call):
-                        continue
-                    iname = dotted_name(inner.func)
-                    if _jnp_probe_name(iname) or (
-                        _host_probe_name(iname)
-                        and _has_float_extraction(inner)
-                    ):
-                        return f"{node.func.id}() reaches {iname}(...)"
+            hit = callgraph.reachable_call(
+                ctx,
+                node,
+                _probe_pred,
+                first_hops=frozenset({"local", "import"}),
+                prune=lambda f: callgraph.traced_in_own_module(f, ctx),
+            )
+            if hit is not None:
+                return f"{node.func.id}() reaches {hit.matched}"
         return None

@@ -29,9 +29,12 @@ where evolutionary code actually puts selection, and bounding the scope
 keeps the false-positive surface small (helpers comparing static config
 are already filtered by the taint engine's static-parameter rules).
 Host-side search loops (this repo's ``AdversarySearch``) drain fitness
-to numpy before comparing and stay clean. Deeper call chains, method
-calls, and cross-module helpers are left to the trace-time error
-itself.
+to numpy before comparing and stay clean. Reachability runs on the
+shared call graph (``analysis/callgraph.py``): the branching helper may
+sit a chain of helpers away — same-module, method, or imported — up to
+the engine's depth bound. Helpers that are themselves traced scopes are
+pruned (a traced helper's branch is rule 2's report, not a second one
+here).
 """
 
 from __future__ import annotations
@@ -39,12 +42,34 @@ from __future__ import annotations
 import ast
 from typing import Iterator, List, Optional, Set, Tuple
 
+from marl_distributedformation_tpu.analysis import callgraph
 from marl_distributedformation_tpu.analysis.linter import (
     TRACING_ENTRY_ARGS,
     ModuleContext,
     Rule,
     dotted_name,
 )
+
+
+def _branching_pred(
+    func: "callgraph.FuncInfo", owner_ctx: ModuleContext
+) -> Optional[str]:
+    """Does this function Python-branch on a comparison of its own
+    (presumed traced) parameters? Traced helpers answer no — their
+    branches are rule 2's report in their own module."""
+    node = func.node
+    if isinstance(node, ast.Lambda) or node in owner_ctx.traced_scopes:
+        return None
+    taint = ModuleContext._param_names(node)
+    for inner in ast.walk(node):
+        if not isinstance(inner, (ast.If, ast.IfExp, ast.While)):
+            continue
+        for cmp_node in ast.walk(inner.test):
+            if isinstance(cmp_node, ast.Compare) and owner_ctx.expr_tainted(
+                cmp_node, taint
+            ):
+                return f"{func.qualname} (line {inner.lineno})"
+    return None
 
 # Tracing entry points whose traced callables are LOOP BODIES — the
 # search-loop shapes (cond fns included: a while_loop condition that
@@ -73,10 +98,11 @@ class TracedComparisonInSearch(Rule):
                     node.func, ast.Name
                 ):
                     continue
-                hit = self._branching_comparison_in(ctx, node.func.id)
+                hit = callgraph.reachable_function(
+                    ctx, node, _branching_pred
+                )
                 if hit is None:
                     continue
-                helper, line = hit
                 if (node.lineno, node.col_offset) in reported:
                     continue
                 reported.add((node.lineno, node.col_offset))
@@ -84,10 +110,11 @@ class TracedComparisonInSearch(Rule):
                     node.lineno,
                     node.col_offset,
                     f"{node.func.id}() is called from a traced search "
-                    f"loop and Python-branches on a comparison of its "
-                    f"arguments (line {line}) — a ConcretizationTypeError "
-                    "at trace time; return jnp.where(cmp, a, b) or use "
-                    "lax.cond so the selection stays in the program",
+                    f"loop and reaches a Python branch on a comparison "
+                    f"of traced arguments in {hit.matched} — a "
+                    "ConcretizationTypeError at trace time; return "
+                    "jnp.where(cmp, a, b) or use lax.cond so the "
+                    "selection stays in the program",
                 )
 
     def _search_sites(self, ctx: ModuleContext) -> List[ast.AST]:
@@ -109,22 +136,3 @@ class TracedComparisonInSearch(Rule):
                     sites.append(node)
         return sites
 
-    def _branching_comparison_in(
-        self, ctx: ModuleContext, name: str
-    ) -> Optional[Tuple[str, int]]:
-        """Does the same-module helper ``name`` branch on a comparison
-        of its presumed-traced parameters? Helpers that are themselves
-        traced scopes are rule 2's report, not a second one here."""
-        for helper in ctx._defs_by_name.get(name, ()):
-            if helper in ctx.traced_scopes:
-                continue
-            taint = ctx._param_names(helper)
-            for node in ast.walk(helper):
-                if not isinstance(node, (ast.If, ast.IfExp, ast.While)):
-                    continue
-                for cmp_node in ast.walk(node.test):
-                    if isinstance(
-                        cmp_node, ast.Compare
-                    ) and ctx.expr_tainted(cmp_node, taint):
-                        return helper.name, node.lineno
-        return None

@@ -293,11 +293,11 @@ class FaultPlane:
     def __init__(self, enabled: bool = False) -> None:
         self.enabled = bool(enabled)
         self._lock = threading.Lock()
-        self._armed: Dict[Tuple[str, int], FaultSpec] = {}
-        self._hits: Dict[str, int] = {}
+        self._armed: Dict[Tuple[str, int], FaultSpec] = {}  # graftlock: guarded-by=_lock
+        self._hits: Dict[str, int] = {}  # graftlock: guarded-by=_lock
         #: Fired faults, in firing order: dicts with the spec record
         #: plus a monotonic ``t`` (the storm's MTTR anchor).
-        self.fired: List[dict] = []
+        self.fired: List[dict] = []  # graftlock: guarded-by=_lock
 
     # -- arming ----------------------------------------------------------
 

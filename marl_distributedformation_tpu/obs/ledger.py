@@ -187,7 +187,7 @@ class ProgramLedger:
         self.enabled = bool(enabled)
         self._lock = threading.Lock()
         # key -> record, registration order preserved (dict semantics).
-        self._entries: Dict[str, ProgramRecord] = {}
+        self._entries: Dict[str, ProgramRecord] = {}  # graftlock: guarded-by=_lock
         # Dispatch-latency histograms ride a PRIVATE MetricsRegistry:
         # same per-thread shards, same dead-thread folding, zero new
         # concurrency code. Always-enabled internally — the gate is
@@ -197,10 +197,13 @@ class ProgramLedger:
         )
         # dispatch_key -> (histogram name, counter name): the hot path
         # avoids two f-string builds per dispatch.
+        # _dispatch_names stays unannotated: the dispatch hot path
+        # writes it lock-free, and racing writers store an identical
+        # tuple for the same key (benign by construction).
         self._dispatch_names: Dict[str, Tuple[str, str]] = {}
-        self._watermark_bytes = 0.0
-        self._memory_bytes = 0.0
-        self._watermark_samples = 0
+        self._watermark_bytes = 0.0  # graftlock: guarded-by=_lock
+        self._memory_bytes = 0.0  # graftlock: guarded-by=_lock
+        self._watermark_samples = 0  # graftlock: guarded-by=_lock
 
     # -- registration (once per compile — lock is fine) -------------------
 

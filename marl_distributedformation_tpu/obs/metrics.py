@@ -152,7 +152,7 @@ class MetricsRegistry:
         self._local = threading.local()
         self._shards_lock = threading.Lock()
         # thread ident -> shard. Read by snapshot().
-        self._shards: Dict[int, _Shard] = {}
+        self._shards: Dict[int, _Shard] = {}  # graftlock: guarded-by=_shards_lock
         # Dead threads' shards FOLD into these accumulators (on ident
         # recycling, reservoir resize, or the periodic dead-thread sweep
         # in _shard) instead of queueing whole shards: counter totals
@@ -160,16 +160,17 @@ class MetricsRegistry:
         # never go backward no matter how many short-lived writer
         # threads come and go — while memory stays bounded by distinct
         # metric names (x reservoir for the retained recent samples).
-        self._retired_counters: Dict[str, float] = {}
-        self._retired_gauges: Dict[str, Tuple[int, float]] = {}
-        self._retired_hist_totals: Dict[str, Tuple[int, float]] = {}
-        self._retired_samples: Dict[str, deque] = {}
+        self._retired_counters: Dict[str, float] = {}  # graftlock: guarded-by=_shards_lock
+        self._retired_gauges: Dict[str, Tuple[int, float]] = {}  # graftlock: guarded-by=_shards_lock
+        self._retired_hist_totals: Dict[str, Tuple[int, float]] = {}  # graftlock: guarded-by=_shards_lock
+        self._retired_samples: Dict[str, deque] = {}  # graftlock: guarded-by=_shards_lock
         # Global write sequence for gauge last-write-wins merging.
         # itertools.count.__next__ is GIL-atomic in CPython.
         self._seq = itertools.count()
 
     # -- recording -------------------------------------------------------
 
+    # graftlock: holds=_shards_lock
     def _fold_retired(self, shard: _Shard) -> None:
         """Fold a dead/displaced shard into the retired accumulators.
         Caller holds ``_shards_lock``."""

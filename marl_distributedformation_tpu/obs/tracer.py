@@ -133,13 +133,13 @@ class Tracer:
         self._local = threading.local()
         self._rings_lock = threading.Lock()
         # thread ident -> (thread name, ring). Read by snapshot().
-        self._rings: Dict[int, Tuple[str, deque]] = {}
+        self._rings: Dict[int, Tuple[str, deque]] = {}  # graftlock: guarded-by=_rings_lock
         # Rings displaced by ident recycling: CPython reuses a dead
         # thread's ident, and a later thread registering under it must
         # not erase the dead thread's retained records — a flight dump
         # after a worker death exists to read exactly that history.
         # Bounded: at most maxlen dead rings of ring_size records each.
-        self._retired: deque = deque(maxlen=8)
+        self._retired: deque = deque(maxlen=8)  # graftlock: guarded-by=_rings_lock
         # Epoch<->monotonic anchor, sampled together at construction.
         self.epoch_anchor = time.time()
         self.mono_anchor = time.perf_counter()

@@ -245,9 +245,9 @@ class PromotionGate:
         self.config = config
         self.program = None  # scenarios.matrix.MatrixProgram, lazy
         self.adversary = None  # scenarios.adversary.AdversarySearch, lazy
-        self._baseline_step: Optional[int] = None
-        self._baseline_clean: Optional[Dict[str, float]] = None
-        self._baseline_cells: Optional[Cells] = None
+        self._baseline_step: Optional[int] = None  # graftlock: guarded-by=_eval_lock
+        self._baseline_clean: Optional[Dict[str, float]] = None  # graftlock: guarded-by=_eval_lock
+        self._baseline_cells: Optional[Cells] = None  # graftlock: guarded-by=_eval_lock
         # Serializes eval bodies. The deadline wrapper ABANDONS a
         # wedged eval thread, but CPython cannot kill it — when it
         # wakes it would otherwise race the next candidate's eval on
@@ -258,10 +258,10 @@ class PromotionGate:
         self._eval_lock = threading.Lock()
         # Promoted-step history so a rollback can rebase the comparison
         # point without re-evaluating (bounded: serving history is short).
-        self._history: Dict[int, Tuple[Dict[str, float], Cells]] = {}
-        self._history_order: List[int] = []
-        self.eval_seconds_total = 0.0
-        self.cells_evaluated = 0
+        self._history: Dict[int, Tuple[Dict[str, float], Cells]] = {}  # graftlock: guarded-by=_eval_lock
+        self._history_order: List[int] = []  # graftlock: guarded-by=_eval_lock
+        self.eval_seconds_total = 0.0  # graftlock: guarded-by=_eval_lock
+        self.cells_evaluated = 0  # graftlock: guarded-by=_eval_lock
 
     # -- evaluation ------------------------------------------------------
 
@@ -342,6 +342,7 @@ class PromotionGate:
         with self._eval_lock:
             return self._evaluate_unlocked(path, trace_id)
 
+    # graftlock: holds=_eval_lock
     def _evaluate_unlocked(
         self, path: Path, trace_id: Optional[str] = None
     ) -> GateVerdict:
@@ -493,17 +494,22 @@ class PromotionGate:
 
     def accept(self, verdict: GateVerdict, keep_history: int = 8) -> None:
         """Install a PROMOTED candidate's already-computed evals as the
-        new comparison baseline (no re-eval, ever)."""
+        new comparison baseline (no re-eval, ever). Takes the eval lock:
+        an ABANDONED eval thread (deadline wrapper gave up on it) that
+        wakes mid-install must not judge against a half-replaced
+        baseline — the same wedge hazard the lock already serializes
+        between candidate evals."""
         assert verdict.passed, "only promoted candidates become baselines"
-        self._baseline_step = verdict.step
-        self._baseline_clean = verdict.clean
-        self._baseline_cells = verdict.cells
-        self._history[verdict.step] = (verdict.clean, verdict.cells)
-        self._history_order.append(verdict.step)
-        while len(self._history_order) > keep_history:
-            dropped = self._history_order.pop(0)
-            if dropped != self._baseline_step:
-                self._history.pop(dropped, None)
+        with self._eval_lock:
+            self._baseline_step = verdict.step
+            self._baseline_clean = verdict.clean
+            self._baseline_cells = verdict.cells
+            self._history[verdict.step] = (verdict.clean, verdict.cells)
+            self._history_order.append(verdict.step)
+            while len(self._history_order) > keep_history:
+                dropped = self._history_order.pop(0)
+                if dropped != self._baseline_step:
+                    self._history.pop(dropped, None)
 
     def rebase(self, step: int) -> None:
         """After a rollback: judge future candidates against the
@@ -511,17 +517,19 @@ class PromotionGate:
         bounded history (a demotion cascade longer than
         ``keep_history``) degrades to bootstrap judging — finite
         candidates pass until the next promotion re-establishes a real
-        baseline — rather than crashing the control plane."""
-        entry = self._history.get(step)
-        if entry is None:
+        baseline — rather than crashing the control plane. Locked like
+        :meth:`accept` (same abandoned-eval race)."""
+        with self._eval_lock:
+            entry = self._history.get(step)
+            if entry is None:
+                self._baseline_step = step
+                self._baseline_clean = None
+                self._baseline_cells = None
+                return
+            clean, cells = entry
             self._baseline_step = step
-            self._baseline_clean = None
-            self._baseline_cells = None
-            return
-        clean, cells = entry
-        self._baseline_step = step
-        self._baseline_clean = clean
-        self._baseline_cells = cells
+            self._baseline_clean = clean
+            self._baseline_cells = cells
 
     # -- observability ---------------------------------------------------
 

@@ -109,7 +109,7 @@ class BucketedPolicyEngine:
         }
         self._acts = {b: self._build_act(b) for b in self.buckets}
         self._base_key = jax.random.PRNGKey(seed)
-        self._dispatches = 0
+        self._dispatches = 0  # graftlock: guarded-by=_lock
         self._lock = threading.Lock()
         # Trailing row shape, recorded on the first successful dispatch:
         # later mismatches fail fast as a ValueError instead of burning

@@ -34,12 +34,12 @@ from marl_distributedformation_tpu.serving.metrics import ServingMetrics
 class FleetMetrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.routed_total = 0
-        self.rejected_total = 0
-        self.failed_over_total = 0
-        self.breaks_total = 0
-        self.probes_total = 0
-        self._routed_per_replica: Dict[int, int] = {}
+        self.routed_total = 0  # graftlock: guarded-by=_lock
+        self.rejected_total = 0  # graftlock: guarded-by=_lock
+        self.failed_over_total = 0  # graftlock: guarded-by=_lock
+        self.breaks_total = 0  # graftlock: guarded-by=_lock
+        self.probes_total = 0  # graftlock: guarded-by=_lock
+        self._routed_per_replica: Dict[int, int] = {}  # graftlock: guarded-by=_lock
 
     # -- recording (router side) ----------------------------------------
 

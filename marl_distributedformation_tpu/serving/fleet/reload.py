@@ -113,9 +113,9 @@ class ReplicaRegistry:
 
     def __init__(self, params: Any, step: int, device: Any = None) -> None:
         self.device = device
-        self.batch_lock = BatchBarrier()
-        self.swap_count = 0
-        self._snapshot: Tuple[Any, int] = (params, step)
+        self.batch_lock = BatchBarrier()  # graftlock: gate
+        self.swap_count = 0  # graftlock: guarded-by=batch_lock
+        self._snapshot: Tuple[Any, int] = (params, step)  # graftlock: guarded-by=batch_lock
 
     def active(self) -> Tuple[Any, int]:
         return self._snapshot
@@ -124,6 +124,7 @@ class ReplicaRegistry:
     def active_step(self) -> int:
         return self._snapshot[1]
 
+    # graftlock: holds=batch_lock
     def install(self, params: Any, step: int) -> None:
         """Replace the serving snapshot. Caller holds ``batch_lock``."""
         self._snapshot = (params, step)
@@ -161,12 +162,14 @@ class FleetReloadCoordinator:
         self.router = router
         self.poll_interval_s = poll_interval_s
         self.commit_timeout_s = commit_timeout_s
-        self.swap_count = 0
+        self.swap_count = 0  # graftlock: guarded-by=_refresh_lock
         # Host-count/commit-round attribution of the newest landed swap
         # (promotions.jsonl schema 4). A single-host fleet always
         # commits 1 host; the mesh coordinator's global commit mirrors
         # this attribute with the real host count and round number.
-        self.last_commit: Optional[dict] = None
+        self.last_commit: Optional[dict] = None  # graftlock: guarded-by=_refresh_lock
+        # Unannotated on purpose: deque.append is atomic under the GIL
+        # and failure paths record without re-entering any lock.
         self.load_errors: Deque[Tuple[str, str]] = deque(
             maxlen=max_recorded_errors
         )
@@ -174,7 +177,7 @@ class FleetReloadCoordinator:
         # mesh coordinator's two-phase barrier holds this host paused —
         # gates closed, every replica barrier held, new params staged —
         # between the prepare ack and the commit/abort decision.
-        self._staged: Optional[dict] = None
+        self._staged: Optional[dict] = None  # graftlock: guarded-by=_staged_lock
         self._staged_lock = threading.Lock()
         # Incremental discovery: a long-running watcher polls this
         # directory forever, and re-listing + re-parsing every historic
@@ -183,7 +186,7 @@ class FleetReloadCoordinator:
         self._discovery = CheckpointDiscovery(self.log_dir)
         # The fleet step starts at the newest step any replica already
         # serves (the router seeds every replica identically).
-        self._fleet_step = max(
+        self._fleet_step = max(  # graftlock: guarded-by=_refresh_lock
             r.registry.active_step for r in router.replicas
         )
         self._refresh_lock = threading.Lock()
@@ -243,6 +246,7 @@ class FleetReloadCoordinator:
                 return False  # already serving exactly this step
             return self._load_and_commit(path, step, trace_id)
 
+    # graftlock: holds=_refresh_lock
     def _load_and_commit(
         self, path: Path, step: int, trace_id: Optional[str] = None
     ) -> bool:
@@ -481,6 +485,7 @@ class FleetReloadCoordinator:
                 ]
             barriers = [r.registry.batch_lock for r, _ in staged]
             held = []
+            wedged_replica = None
             try:
                 for b in barriers:
                     b.close()
@@ -503,14 +508,7 @@ class FleetReloadCoordinator:
                             "(wedged dispatch?); old step keeps serving"
                         )
                         self.load_errors.append((str(path), reason))
-                        tracer.incident(
-                            "wedged_barrier_abort",
-                            trace_id=trace_id,
-                            replica=i,
-                            step=step,
-                            path=str(path),
-                            commit_timeout_s=self.commit_timeout_s,
-                        )
+                        wedged_replica = i
                         return False, reason
                     held.append(b)
             except BaseException as e:
@@ -531,6 +529,20 @@ class FleetReloadCoordinator:
                         h.release()
                     for b in barriers:
                         b.open()
+                if wedged_replica is not None:
+                    # Postmortem dump AFTER the partial acquisitions
+                    # released and the gates reopened — mirroring
+                    # _load_and_commit, the flight-recorder file write
+                    # must not extend the serving pause the wedged
+                    # barrier already caused.
+                    tracer.incident(
+                        "wedged_barrier_abort",
+                        trace_id=trace_id,
+                        replica=wedged_replica,
+                        step=step,
+                        path=str(path),
+                        commit_timeout_s=self.commit_timeout_s,
+                    )
             timer: Optional[threading.Timer] = None
             entry = {
                 "round_tag": f"step{step}",
@@ -565,10 +577,13 @@ class FleetReloadCoordinator:
             entry["timer"].cancel()
         return entry
 
+    # graftlock: holds=_refresh_lock
     def commit_prepared(self, trace_id: Optional[str] = None) -> bool:
         """Phase 2: flip every staged replica and resume. Returns False
         when nothing is staged (an aborted/TTL-expired round — the
-        coordinator treats that as this host having dropped out)."""
+        coordinator treats that as this host having dropped out).
+        The refresh lock was acquired by :meth:`prepare_global` and is
+        released here (or by abort) — the staged window holds it."""
         entry = self._take_staged()
         if entry is None:
             return False

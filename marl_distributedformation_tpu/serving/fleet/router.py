@@ -85,9 +85,11 @@ class Replica:
     engine: BucketedPolicyEngine
     scheduler: MicroBatchScheduler
     registry: ReplicaRegistry
-    healthy: bool = True
-    broken_at: float = 0.0
-    break_reason: str = ""
+    # Circuit-breaker state is owned by the router's health lock: break,
+    # readmit, and re-arm all mutate under ``FleetRouter._health_lock``.
+    healthy: bool = True  # graftlock: guarded-by=_health_lock
+    broken_at: float = 0.0  # graftlock: guarded-by=_health_lock
+    break_reason: str = ""  # graftlock: guarded-by=_health_lock
     kind: str = "replicated"
 
 
@@ -496,7 +498,12 @@ class FleetRouter:
                         r.healthy = True
                         r.break_reason = ""
             else:
-                r.broken_at = now  # still dead; re-check next interval
+                # Re-arm under the same lock every other breaker-state
+                # write holds — two routing threads probing the same
+                # dead replica must not interleave with a concurrent
+                # break/readmit.
+                with self._health_lock:
+                    r.broken_at = now  # still dead; re-check next interval
 
     def kill_replica(self, index: int, reason: str = "killed") -> None:
         """Stop one replica's worker (chaos hook, used by tests and the

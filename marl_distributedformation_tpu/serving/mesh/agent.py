@@ -64,13 +64,13 @@ class HostAgent:
         self.catch_ups = 0
         self.catch_up_failures = 0
         self._catch_up_thread: Optional[threading.Thread] = None
-        self._round: Optional[int] = None
+        self._round: Optional[int] = None  # graftlock: guarded-by=_round_lock
         # The last resolved commit, kept for idempotency: a commit RPC
         # whose response was lost (client timeout racing a slow
         # install) is retried by the coordinator, and the retry must
         # report what actually happened — not refuse a round this host
         # already landed.
-        self._committed: Optional[tuple] = None  # (round, ok, step)
+        self._committed: Optional[tuple] = None  # graftlock: guarded-by=_round_lock — (round, ok, step)
         self._round_lock = threading.Lock()
         self._server = JsonRpcServer(
             {

@@ -72,14 +72,17 @@ class MeshHost:
     host_id: str
     control_url: str
     data_url: str
-    step: int  # newest step this host is KNOWN to serve
-    last_beat: float  # monotonic
-    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
-    beats: int = 0
-    forced_dead: bool = False  # marked dead out-of-band (barrier RPC
-    # unreachable); a fresh heartbeat clears it
-    dead_reason: str = ""
-    committed_round: int = -1  # last round whose commit this host acked
+    # Every mutable field below is owned by the coordinator's registry
+    # lock: heartbeats, sweeps, out-of-band death verdicts, and commit
+    # legs all mutate through ``MeshCoordinator._hosts_lock``.
+    step: int  # graftlock: guarded-by=_hosts_lock — newest KNOWN served step
+    last_beat: float  # graftlock: guarded-by=_hosts_lock — monotonic
+    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)  # graftlock: guarded-by=_hosts_lock
+    beats: int = 0  # graftlock: guarded-by=_hosts_lock
+    forced_dead: bool = False  # graftlock: guarded-by=_hosts_lock — out-of-band
+    # death verdict (barrier RPC unreachable); a fresh heartbeat clears it
+    dead_reason: str = ""  # graftlock: guarded-by=_hosts_lock
+    committed_round: int = -1  # graftlock: guarded-by=_hosts_lock — last acked commit round
 
     def record(self, state: str) -> dict:
         return {
@@ -137,16 +140,20 @@ class MeshCoordinator:
         # fires when the coordinator itself died mid-round.
         self.prepare_ttl_s = float(prepare_ttl_s)
         self.poll_interval_s = float(poll_interval_s)
-        self.swap_count = 0
-        self.commit_round = 0
-        self.last_commit: Optional[dict] = None
-        self.last_commit_path: Optional[str] = None
+        self.swap_count = 0  # graftlock: guarded-by=_refresh_lock
+        self.commit_round = 0  # graftlock: guarded-by=_refresh_lock
+        self.last_commit: Optional[dict] = None  # graftlock: guarded-by=_refresh_lock
+        self.last_commit_path: Optional[str] = None  # graftlock: guarded-by=_refresh_lock
+        # Unannotated on purpose: deque.append is atomic under the GIL
+        # and the watch thread records poll failures without a lock.
         self.load_errors: Deque[Tuple[str, str]] = deque(
             maxlen=max_recorded_errors
         )
-        self._mesh_step = -1
-        self._hosts: Dict[str, MeshHost] = {}
-        self._hosts_lock = threading.Lock()
+        self._mesh_step = -1  # graftlock: guarded-by=_hosts_lock
+        self._hosts: Dict[str, MeshHost] = {}  # graftlock: guarded-by=_hosts_lock
+        # Held on EVERY heartbeat/register RPC; any blocking work under
+        # it stalls the whole gossip plane — hence the gate marking.
+        self._hosts_lock = threading.Lock()  # graftlock: gate
         self._refresh_lock = threading.Lock()
         self._discovery = (
             CheckpointDiscovery(self.log_dir)
@@ -348,29 +355,37 @@ class MeshCoordinator:
         only exists to make transitions OBSERVABLE, not to make them
         happen."""
         now = time.monotonic()
-        with self._hosts_lock:
-            hosts = list(self._hosts.values())
-        registry = get_registry()
         alive = suspect = dead = 0
-        for h in hosts:
-            state = self._state(h, now)
-            if state == HOST_ALIVE:
-                alive += 1
-            elif state == HOST_SUSPECT:
-                suspect += 1
-            else:
-                dead += 1
-                if not h.dead_reason:
-                    h.dead_reason = (
-                        f"lease expired {now - h.last_beat:.2f}s ago"
-                    )
-                    registry.counter("mesh_host_deaths_total").inc()
-                    get_tracer().incident(
-                        "mesh_host_dead",
-                        host_id=h.host_id,
-                        silence_s=round(now - h.last_beat, 3),
-                    )
-        registry.gauge("mesh_hosts").set(len(hosts))
+        died: List[Tuple[str, float]] = []
+        with self._hosts_lock:
+            total = len(self._hosts)
+            for h in self._hosts.values():
+                state = self._state(h, now)
+                if state == HOST_ALIVE:
+                    alive += 1
+                elif state == HOST_SUSPECT:
+                    suspect += 1
+                else:
+                    dead += 1
+                    if not h.dead_reason:
+                        # The verdict write stays under the registry
+                        # lock — heartbeats clear dead_reason and
+                        # mark_dead sets it, both under _hosts_lock.
+                        h.dead_reason = (
+                            f"lease expired {now - h.last_beat:.2f}s ago"
+                        )
+                        died.append((h.host_id, now - h.last_beat))
+        # Counters and the incident dump run AFTER release: the tracer's
+        # ring lock must never nest under the heartbeat dispatch lock.
+        registry = get_registry()
+        for host_id, silence_s in died:
+            registry.counter("mesh_host_deaths_total").inc()
+            get_tracer().incident(
+                "mesh_host_dead",
+                host_id=host_id,
+                silence_s=round(silence_s, 3),
+            )
+        registry.gauge("mesh_hosts").set(total)
         registry.gauge("mesh_hosts_alive").set(alive)
         registry.gauge("mesh_hosts_suspect").set(suspect)
         registry.gauge("mesh_hosts_dead").set(dead)
@@ -446,6 +461,7 @@ class MeshCoordinator:
         """Explicit-path global swap (the CLI / smoke entry)."""
         return self.reload_pinned(path, monotonic=monotonic, trace_id=trace_id)
 
+    # graftlock: holds=_refresh_lock
     def _global_reload_locked(
         self,
         path: Path,
@@ -615,7 +631,12 @@ class MeshCoordinator:
             )
             registry.counter("mesh_reload_aborts_total").inc()
             return False
-        self._mesh_step = step
+        with self._hosts_lock:
+            # The mesh step is the heartbeat/quarantine comparison point
+            # (read by _beat_reply and routable_hosts under _hosts_lock)
+            # — advancing it under only _refresh_lock let a concurrent
+            # beat observe the new step before the host records did.
+            self._mesh_step = step
         self.swap_count += 1
         self.last_commit_path = str(path)
         self.last_commit = {

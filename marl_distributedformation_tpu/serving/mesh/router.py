@@ -112,13 +112,13 @@ class MetaRouter:
         self.max_failovers = int(max_failovers)
         self.probe_interval_s = float(probe_interval_s)
         self._lock = threading.Lock()
-        self._broken: Dict[str, Tuple[float, str]] = {}  # id -> (t, why)
-        self._inflight: Dict[str, int] = {}
-        self.routed_total = 0
-        self.failed_over_total = 0
-        self.rejected_total = 0
-        self.breaks_total = 0
-        self._routed_per_host: Dict[str, int] = {}
+        self._broken: Dict[str, Tuple[float, str]] = {}  # graftlock: guarded-by=_lock — id -> (t, why)
+        self._inflight: Dict[str, int] = {}  # graftlock: guarded-by=_lock
+        self.routed_total = 0  # graftlock: guarded-by=_lock
+        self.failed_over_total = 0  # graftlock: guarded-by=_lock
+        self.rejected_total = 0  # graftlock: guarded-by=_lock
+        self.breaks_total = 0  # graftlock: guarded-by=_lock
+        self._routed_per_host: Dict[str, int] = {}  # graftlock: guarded-by=_lock
 
     # -- client side -----------------------------------------------------
 

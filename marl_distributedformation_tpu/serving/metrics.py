@@ -32,16 +32,16 @@ from typing import Deque, Dict, List
 class ServingMetrics:
     def __init__(self, latency_window: int = 4096) -> None:
         self._lock = threading.Lock()
-        self._latencies: Deque[float] = deque(maxlen=latency_window)
-        self._batch_seconds: Deque[float] = deque(maxlen=256)
-        self.requests_total = 0
-        self.rows_total = 0
-        self.batches_total = 0
-        self.padded_rows_total = 0
-        self.rejected_total = 0
-        self.timeouts_total = 0
-        self.preempted_total = 0  # batch requests that yielded their slot
-        self.queue_depth = 0
+        self._latencies: Deque[float] = deque(maxlen=latency_window)  # graftlock: guarded-by=_lock
+        self._batch_seconds: Deque[float] = deque(maxlen=256)  # graftlock: guarded-by=_lock
+        self.requests_total = 0  # graftlock: guarded-by=_lock
+        self.rows_total = 0  # graftlock: guarded-by=_lock
+        self.batches_total = 0  # graftlock: guarded-by=_lock
+        self.padded_rows_total = 0  # graftlock: guarded-by=_lock
+        self.rejected_total = 0  # graftlock: guarded-by=_lock
+        self.timeouts_total = 0  # graftlock: guarded-by=_lock
+        self.preempted_total = 0  # graftlock: guarded-by=_lock — yielded batch slots
+        self.queue_depth = 0  # graftlock: guarded-by=_lock
 
     # -- recording (scheduler side) -------------------------------------
 

@@ -92,13 +92,13 @@ class ModelRegistry:
         # would re-upload the full weight tree every micro-batch.
         policy.params = jax.device_put(policy.params)
         self.poll_interval_s = poll_interval_s
-        self.swap_count = 0
+        self.swap_count = 0  # graftlock: guarded-by=_lock
         self.load_errors: Deque[Tuple[str, str]] = deque(
             maxlen=max_recorded_errors
         )
         self._lock = threading.Lock()
-        self._params = policy.params
-        self._step = step
+        self._params = policy.params  # graftlock: guarded-by=_lock
+        self._step = step  # graftlock: guarded-by=_lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

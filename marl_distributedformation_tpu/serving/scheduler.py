@@ -129,8 +129,8 @@ class _ClassedQueue:
     def __init__(self, maxsize: int) -> None:
         self._maxsize = maxsize
         self._cond = threading.Condition()
-        self._interactive: "deque[_Request]" = deque()
-        self._batch: "deque[_Request]" = deque()
+        self._interactive: "deque[_Request]" = deque()  # graftlock: guarded-by=_cond
+        self._batch: "deque[_Request]" = deque()  # graftlock: guarded-by=_cond
 
     def qsize(self) -> int:
         with self._cond:
@@ -157,6 +157,7 @@ class _ClassedQueue:
                 return evicted
             raise queue.Full
 
+    # graftlock: holds=_cond
     def _pop(self) -> Optional[_Request]:
         if self._interactive:
             return self._interactive.popleft()

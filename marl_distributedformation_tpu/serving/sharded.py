@@ -253,11 +253,11 @@ class ShardedPolicyEngine(BucketedPolicyEngine):
         # _run). The lock serializes the one lowering per rung — a
         # concurrent lower would burn a second trace against the
         # budget-1 guard.
-        self._compiled: Dict[int, Any] = {}
+        self._compiled: Dict[int, Any] = {}  # graftlock: guarded-by=_compile_lock
         self._compile_lock = threading.Lock()
         # bucket -> program-ledger dispatch key (set when the rung's
         # AOT executable registers; see _run).
-        self._ledger_keys: Dict[int, Optional[str]] = {}
+        self._ledger_keys: Dict[int, Optional[str]] = {}  # graftlock: guarded-by=_compile_lock
         self._seed = int(seed)
         super().__init__(
             policy,

@@ -446,7 +446,7 @@ class Trainer:
         # thread (the pipeline supervisor feeding gate falsifiers back)
         # is applied at the next dispatch boundary — the only place the
         # training thread touches schedule state.
-        self._pending_schedule: Any = None
+        self._pending_schedule: Any = None  # graftlock: guarded-by=_schedule_lock
         self._schedule_lock = threading.Lock()
         if scenario_schedule is not None:
             if self._env_step_fn is not None:

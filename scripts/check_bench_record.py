@@ -404,6 +404,42 @@ def _recovery_problems(rec: dict) -> list[str]:
     return problems
 
 
+LINT_WALL_CEILING_S = 120.0
+
+
+def _lint_problems(rec: dict) -> list[str]:
+    """Structural validation of the graftlint field (bench phase 16),
+    whenever present: one cold-process ``--check`` pass over the
+    package must be a finite positive wall under the ceiling. The
+    engine's whole-repo analyses (lock-edge DFS, guarded-write reach)
+    are package-global — this is the tripwire that keeps them from
+    quietly going super-linear as the repo grows (measured wall is a
+    few seconds; the ceiling leaves ~25x headroom for slow CI hosts).
+    ``"skipped"`` sentinels are honored as structurally absent."""
+    problems = []
+    wall = _present(rec, "graftlint_wall_s")
+    if wall is not None:
+        try:
+            v = float(wall)
+            if not math.isfinite(v) or v <= 0.0:
+                problems.append(
+                    f"graftlint_wall_s={wall!r} (need a finite number "
+                    "> 0)"
+                )
+            elif v > LINT_WALL_CEILING_S:
+                problems.append(
+                    f"graftlint_wall_s={v} breaches the "
+                    f"{LINT_WALL_CEILING_S:.0f}s ceiling — a package-"
+                    "global analysis in the call-graph engine has "
+                    "gone super-linear"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"graftlint_wall_s is not a number: {wall!r}"
+            )
+    return problems
+
+
 def _ledger_problems(rec: dict) -> list[str]:
     """Structural validation of the program-ledger fields (bench phase
     13), whenever present: the enabled-ledger overhead must be a finite
@@ -651,6 +687,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_recovery_problems(rec))
     problems.extend(_ledger_problems(rec))
     problems.extend(_mesh_problems(rec))
+    problems.extend(_lint_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(

@@ -4,6 +4,7 @@
 Usage:
     python scripts/graftlint.py [paths...]          # report, exit 0
     python scripts/graftlint.py --check [paths...]  # exit 1 on any ERROR
+    python scripts/graftlint.py --format sarif      # SARIF 2.1.0 on stdout
 
 Default path is the ``marl_distributedformation_tpu`` package.
 Configuration comes from ``[tool.graftlint]`` in pyproject.toml
@@ -19,6 +20,7 @@ the linted tree is imported or executed.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import types
 from pathlib import Path
@@ -46,7 +48,80 @@ _stub_package("marl_distributedformation_tpu.analysis", _PKG / "analysis")
 
 from marl_distributedformation_tpu.analysis.config import load_config  # noqa: E402
 from marl_distributedformation_tpu.analysis.linter import lint_paths  # noqa: E402
-from marl_distributedformation_tpu.analysis.rules import rule_names  # noqa: E402
+from marl_distributedformation_tpu.analysis.rules import (  # noqa: E402
+    all_rules,
+    rule_names,
+)
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+_SARIF_LEVELS = {"error": "error", "warn": "warning"}
+
+
+def sarif_report(violations, root: Path) -> dict:
+    """The lint result as a SARIF 2.1.0 document. Rule metadata (id +
+    short description) rides in the driver so viewers can group by rule;
+    each result carries the full message text — for lock-ordering
+    findings that text includes the complete acquisition chain (every
+    ``holding A acquires B in fn (file:line)`` edge of the cycle)."""
+    rules = all_rules()
+    rule_index = {r.name: i for i, r in enumerate(rules)}
+
+    def uri(path: str) -> str:
+        p = Path(path)
+        try:
+            p = p.resolve().relative_to(root.resolve())
+        except ValueError:
+            pass
+        return p.as_posix()
+
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "rules": [
+                            {
+                                "id": r.name,
+                                "shortDescription": {"text": r.description},
+                                "defaultConfiguration": {
+                                    "level": _SARIF_LEVELS[r.default_severity]
+                                },
+                            }
+                            for r in rules
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": v.rule,
+                        "ruleIndex": rule_index.get(v.rule, -1),
+                        "level": _SARIF_LEVELS.get(v.severity, "warning"),
+                        "message": {"text": v.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": uri(v.path)},
+                                    "region": {
+                                        "startLine": v.line,
+                                        # SARIF columns are 1-based;
+                                        # ast col_offset is 0-based.
+                                        "startColumn": v.col + 1,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for v in violations
+                ],
+            }
+        ],
+    }
 
 
 def main(argv=None) -> int:
@@ -65,6 +140,12 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print rule names and exit"
     )
+    parser.add_argument(
+        "--format",
+        choices=("text", "sarif"),
+        default="text",
+        help="output format: human-readable text (default) or SARIF 2.1.0",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -74,14 +155,25 @@ def main(argv=None) -> int:
 
     config = load_config(REPO_ROOT)
     violations = lint_paths(args.paths, config, root=REPO_ROOT)
-    for v in violations:
-        print(v)
     errors = sum(1 for v in violations if v.severity == "error")
-    warns = len(violations) - errors
-    print(
-        f"graftlint: {errors} error(s), {warns} warning(s) in "
-        f"{', '.join(str(p) for p in args.paths)}"
-    )
+    if args.format == "sarif":
+        # stdout is the document — the human summary goes to stderr so
+        # `graftlint --format sarif > out.sarif` stays valid JSON.
+        json.dump(sarif_report(violations, REPO_ROOT), sys.stdout, indent=2)
+        print()
+        print(
+            f"graftlint: {errors} error(s), "
+            f"{len(violations) - errors} warning(s)",
+            file=sys.stderr,
+        )
+    else:
+        for v in violations:
+            print(v)
+        print(
+            f"graftlint: {errors} error(s), "
+            f"{len(violations) - errors} warning(s) in "
+            f"{', '.join(str(p) for p in args.paths)}"
+        )
     if args.check and errors:
         return 1
     return 0

@@ -1,0 +1,332 @@
+"""Unit tests for the whole-repo call-graph + lock-context engine
+(`analysis/callgraph.py`): annotation parsing, thread-target discovery,
+transitive lock context, cycle detection, and the mtime-keyed cache."""
+
+import ast
+import os
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from marl_distributedformation_tpu.analysis.callgraph import (  # noqa: E402
+    LOCK_ORDERING_CYCLE,
+    UNGUARDED_SHARED_MUTATION,
+    CallGraphEngine,
+    ModuleInfo,
+    PackageGraph,
+    parse_annotations,
+)
+from marl_distributedformation_tpu.analysis.linter import (  # noqa: E402
+    ModuleContext,
+)
+
+
+def graph(src: str) -> PackageGraph:
+    """One in-memory module, analyzed alone (the fixture path)."""
+    source = textwrap.dedent(src)
+    mod = ModuleInfo("mem.py", ast.parse(source), source)
+    return PackageGraph({"mem.py": mod}, CallGraphEngine())
+
+
+# ---------------------------------------------------------------------------
+# Annotation grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_annotations_guarded_by():
+    out = parse_annotations("self.step = 0  # graftlock: guarded-by=_lock")
+    assert out == {"guarded-by": ["_lock"]}
+
+
+def test_parse_annotations_trailing_prose_is_ignored():
+    # Parsing stops at the first non-key token: annotation lines can
+    # carry human prose after the payload without corrupting it.
+    out = parse_annotations(
+        "last_beat: float  # graftlock: guarded-by=_hosts_lock — monotonic"
+    )
+    assert out == {"guarded-by": ["_hosts_lock"]}
+
+
+def test_parse_annotations_gate_and_multiple_keys():
+    out = parse_annotations(
+        "self._g = threading.Lock()  # graftlock: gate lock=_g"
+    )
+    assert out == {"gate": [], "lock": ["_g"]}
+
+
+def test_parse_annotations_absent():
+    assert parse_annotations("self.step = 0  # plain comment") == {}
+
+
+# ---------------------------------------------------------------------------
+# Thread-target discovery
+# ---------------------------------------------------------------------------
+
+
+def test_thread_target_discovery():
+    pg = graph(
+        """
+        import threading
+
+        class Server:
+            def __init__(self, pool):
+                self._pool = pool
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+                threading.Timer(1.0, self._tick).start()
+                self._pool.submit(self._job)
+                serve({"register": self._rpc_register})
+
+            def _worker(self):
+                pass
+
+            def _tick(self):
+                pass
+
+            def _job(self):
+                pass
+
+            def _rpc_register(self, msg):
+                pass
+        """
+    )
+    entries = {f.qualname for f in pg._thread_entries()}
+    assert entries == {
+        "Server._worker",
+        "Server._tick",
+        "Server._job",
+        "Server._rpc_register",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Transitive lock context
+# ---------------------------------------------------------------------------
+
+_STORE = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self.read_lock = threading.Lock()
+            self.write_lock = threading.Lock()
+
+        def flush(self):
+            with self.read_lock:
+                self._sync()
+
+        def _sync(self):
+            with self.write_lock:
+                pass
+"""
+
+
+def test_lock_edge_through_call_chain():
+    # flush never mentions write_lock — the edge exists only because
+    # the held context flows through the flush -> _sync call.
+    pg = graph(_STORE)
+    edges = {
+        (a.rsplit(".", 1)[-1], b.rsplit(".", 1)[-1])
+        for a, b in pg.lock_edges
+    }
+    assert ("read_lock", "write_lock") in edges
+    site = next(
+        s
+        for (a, b), s in pg.lock_edges.items()
+        if b.endswith("write_lock")
+    )
+    assert site.qualname == "Store._sync"
+    assert any(k.endswith("read_lock") for k in site.chain)
+
+
+def test_timed_acquire_creates_no_edge():
+    pg = graph(
+        """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.read_lock = threading.Lock()
+                self.write_lock = threading.Lock()
+
+            def compact(self):
+                with self.read_lock:
+                    if self.write_lock.acquire(timeout=1.0):
+                        self.write_lock.release()
+        """
+    )
+    assert pg.lock_edges == {}
+
+
+def test_holds_annotation_seeds_held_context():
+    pg = graph(
+        """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.read_lock = threading.Lock()
+                self.write_lock = threading.Lock()
+
+            # graftlock: holds=read_lock
+            def _commit_locked(self):
+                with self.write_lock:
+                    pass
+        """
+    )
+    edges = {
+        (a.rsplit(".", 1)[-1], b.rsplit(".", 1)[-1])
+        for a, b in pg.lock_edges
+    }
+    assert ("read_lock", "write_lock") in edges
+
+
+# ---------------------------------------------------------------------------
+# Cycle detection
+# ---------------------------------------------------------------------------
+
+
+def test_three_lock_cycle_reports_full_acquisition_chain():
+    pg = graph(
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+                self.c_lock = threading.Lock()
+
+            def ab(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def bc(self):
+                with self.b_lock:
+                    with self.c_lock:
+                        pass
+
+            def ca(self):
+                with self.c_lock:
+                    with self.a_lock:
+                        pass
+        """
+    )
+    found = pg.findings_for("mem.py", LOCK_ORDERING_CYCLE)
+    assert len(found) == 1
+    (_, _, msg) = found[0]
+    # The full chain: every edge of the ring, each with its owning
+    # function and file:line, joined into one message.
+    assert msg.count("holding") == 3
+    for qualname in ("Pool.ab", "Pool.bc", "Pool.ca"):
+        assert qualname in msg
+    for lock in ("a_lock", "b_lock", "c_lock"):
+        assert lock in msg
+    assert "mem.py:" in msg
+
+
+def test_consistent_order_has_no_cycle():
+    pg = graph(
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+
+            def ab(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def also_ab(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+        """
+    )
+    assert pg.findings_for("mem.py", LOCK_ORDERING_CYCLE) == []
+
+
+# ---------------------------------------------------------------------------
+# Cache invalidation: edit a module, the graph re-resolves
+# ---------------------------------------------------------------------------
+
+
+def _all_messages(pg: PackageGraph):
+    return [
+        msg
+        for per_rule in pg.findings.values()
+        for msgs in per_rule.values()
+        for (_, _, msg) in msgs
+    ]
+
+
+def test_cache_invalidation_on_module_edit(tmp_path):
+    helper = tmp_path / "helper.py"
+    main = tmp_path / "main.py"
+    helper.write_text(
+        textwrap.dedent(
+            """
+            def bump(c):
+                pass
+            """
+        )
+    )
+    main.write_text(
+        textwrap.dedent(
+            """
+            import threading
+            from helper import bump
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0  # graftlock: guarded-by=_lock
+
+                def start(self):
+                    threading.Thread(target=self._worker).start()
+
+                def _worker(self):
+                    bump(self)
+            """
+        )
+    )
+    eng = CallGraphEngine()
+
+    def analyze() -> PackageGraph:
+        mod = eng.module(main)
+        ctx = ModuleContext(mod.tree, "\n".join(mod.lines), mod.path)
+        return eng.package_for(ctx)
+
+    first = analyze()
+    assert _all_messages(first) == []
+
+    # Same snapshot -> the cached PackageGraph is returned as-is.
+    assert analyze() is first
+
+    # Edit ONLY the helper: the cross-module write now violates main's
+    # guarded-by declaration. The package snapshot (mtime_ns, size)
+    # changes, so the graph must re-resolve without a process restart.
+    helper.write_text(
+        textwrap.dedent(
+            """
+            def bump(c):
+                c.total = c.total + 1
+            """
+        )
+    )
+    st = helper.stat()
+    os.utime(helper, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+
+    second = analyze()
+    assert second is not first
+    hits = second.findings_for(str(helper), UNGUARDED_SHARED_MUTATION)
+    assert len(hits) == 1
+    assert "guarded-by='_lock'" in hits[0][2]

@@ -465,6 +465,17 @@ def test_check_bench_record_gates():
         },
         [], [],
     ) == []
+    # graftlint wall (bench phase 16), validated whenever present:
+    # finite positive and under the static ceiling (the engine's
+    # package-global analyses must not go super-linear).
+    assert check({**clean, "graftlint_wall_s": 4.7}, [], []) == []
+    assert check({**clean, "graftlint_wall_s": 0.0}, [], [])
+    assert check({**clean, "graftlint_wall_s": -1.0}, [], [])
+    assert check({**clean, "graftlint_wall_s": float("nan")}, [], [])
+    assert check({**clean, "graftlint_wall_s": float("inf")}, [], [])
+    assert check({**clean, "graftlint_wall_s": 500.0}, [], [])
+    assert check({**clean, "graftlint_wall_s": "slow"}, [], [])
+    assert check({**clean, "graftlint_wall_s": "skipped"}, [], []) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

@@ -327,6 +327,52 @@ def test_coordinator_commit_aborts_cleanly_on_wedged_replica(tmp_path):
     assert all(r.registry.active_step == 20 for r in router.replicas)
 
 
+def test_wedged_abort_incident_fires_after_gates_reopen(tmp_path):
+    """Regression: the ``wedged_barrier_abort`` postmortem (a flight-
+    recorder file write) must fire AFTER the partially-acquired
+    barriers are released and every gate reopened — it used to fire
+    from inside the acquisition loop, extending the fleet-wide serving
+    pause the wedged barrier already caused by the dump's IO."""
+    from marl_distributedformation_tpu.obs import get_tracer
+
+    _write_ckpt(tmp_path, 10, _make_policy(seed=0))
+    router, coordinator = fleet_from_checkpoint_dir(
+        tmp_path, num_replicas=2, buckets=(1, 8), probe_interval_s=60.0
+    )
+    coordinator.commit_timeout_s = 0.2
+    warmup_fleet(router, (OBS_DIM,))
+    candidate = _write_ckpt(tmp_path, 20, _make_policy(seed=1))
+    healthy = router.replicas[0].registry.batch_lock
+    wedged = router.replicas[1].registry.batch_lock
+    wedged.acquire()  # simulate a worker stuck inside a device dispatch
+    tracer = get_tracer()
+    states = []
+    original = tracer.incident
+
+    def spy(name, **fields):
+        if name == "wedged_barrier_abort":
+            states.append(
+                (
+                    healthy._lock.locked(),
+                    healthy._open.is_set(),
+                    wedged._open.is_set(),
+                )
+            )
+        return original(name, **fields)
+
+    tracer.incident = spy
+    try:
+        with router:
+            staged, reason = coordinator.prepare_global(candidate)
+    finally:
+        tracer.incident = original
+        wedged.release()
+    assert not staged and "barrier not acquired" in reason
+    # Exactly one dump, and at dump time: the healthy replica's barrier
+    # is released and BOTH gates are open again (workers unparked).
+    assert states == [(False, True, True)], states
+
+
 def test_coordinator_background_watcher_swaps(tmp_path):
     _write_ckpt(tmp_path, 1, _make_policy(seed=0))
     router, coordinator = fleet_from_checkpoint_dir(

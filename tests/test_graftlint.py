@@ -8,6 +8,7 @@ engine cannot silently lobotomize a rule while the package scan still
 reports zero.
 """
 
+import json
 import subprocess
 import sys
 import textwrap
@@ -1246,6 +1247,344 @@ FIXTURES = [
             return state, final_ok
         """,
     ),
+    (
+        # Rule 23: three locks acquired pairwise in a ring (a→b, b→c,
+        # c→a) — two threads entering from different edges deadlock.
+        # The good twin acquires the same locks in one global order.
+        "lock-ordering-cycle",
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+                self.c_lock = threading.Lock()
+
+            def ab(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def bc(self):
+                with self.b_lock:
+                    with self.c_lock:
+                        pass
+
+            def ca(self):
+                with self.c_lock:
+                    with self.a_lock:
+                        pass
+        """,
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+                self.c_lock = threading.Lock()
+
+            def ab(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def bc(self):
+                with self.b_lock:
+                    with self.c_lock:
+                        pass
+
+            def ac(self):
+                with self.a_lock:
+                    with self.c_lock:
+                        pass
+        """,
+    ),
+    (
+        # Rule 23 again: a two-lock inversion hidden behind a call —
+        # flush holds read_lock and calls a helper that takes
+        # write_lock, while compact nests them the other way round.
+        # The good twin gives compact the same read→write order.
+        "lock-ordering-cycle",
+        """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.read_lock = threading.Lock()
+                self.write_lock = threading.Lock()
+
+            def flush(self):
+                with self.read_lock:
+                    self._sync()
+
+            def _sync(self):
+                with self.write_lock:
+                    pass
+
+            def compact(self):
+                with self.write_lock:
+                    with self.read_lock:
+                        pass
+        """,
+        """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.read_lock = threading.Lock()
+                self.write_lock = threading.Lock()
+
+            def flush(self):
+                with self.read_lock:
+                    self._sync()
+
+            def _sync(self):
+                with self.write_lock:
+                    pass
+
+            def compact(self):
+                with self.read_lock:
+                    with self.write_lock:
+                        pass
+        """,
+    ),
+    (
+        # Rule 24: an attribute declared guarded-by a lock, written
+        # from a thread-reachable method without holding it. The good
+        # twin wraps the write.
+        "unguarded-shared-mutation",
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0  # graftlock: guarded-by=_lock
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def _worker(self):
+                self.total = self.total + 1
+        """,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0  # graftlock: guarded-by=_lock
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def _worker(self):
+                with self._lock:
+                    self.total = self.total + 1
+        """,
+    ),
+    (
+        # Rule 24 again: the unguarded write hides one call deep — the
+        # thread entry calls a helper that mutates. The good twin holds
+        # the lock at the caller; the held context flows through the
+        # call edge, so the helper needs no lock of its own.
+        "unguarded-shared-mutation",
+        """
+        import threading
+
+        class Ring:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.head = 0  # graftlock: guarded-by=_lock
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self._advance()
+
+            def _advance(self):
+                self.head = self.head + 1
+        """,
+        """
+        import threading
+
+        class Ring:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.head = 0  # graftlock: guarded-by=_lock
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._advance()
+
+            def _advance(self):
+                self.head = self.head + 1
+        """,
+    ),
+    (
+        # Rule 25: sleeping while the batch gate is held keeps every
+        # replica's barrier closed for the duration. The good twin
+        # sleeps after releasing it.
+        "blocking-call-under-dispatch-lock",
+        """
+        import threading
+        import time
+
+        class Dispatcher:
+            def __init__(self):
+                self.batch_lock = threading.Lock()
+                self.backoff_s = 0.5
+
+            def flush(self):
+                with self.batch_lock:
+                    time.sleep(self.backoff_s)
+        """,
+        """
+        import threading
+        import time
+
+        class Dispatcher:
+            def __init__(self):
+                self.batch_lock = threading.Lock()
+                self.backoff_s = 0.5
+
+            def flush(self):
+                with self.batch_lock:
+                    pending = self.backoff_s
+                time.sleep(pending)
+        """,
+    ),
+    (
+        # Rule 25 again: a gate-annotated lock held across a device
+        # drain — jax.device_get blocks on the accelerator stream. The
+        # good twin snapshots the reference under the gate and drains
+        # after releasing it.
+        "blocking-call-under-dispatch-lock",
+        """
+        import threading
+        import jax
+
+        class DrainGate:
+            def __init__(self):
+                self._drain_gate = threading.Lock()  # graftlock: gate
+                self._buf = None
+
+            def drain(self):
+                with self._drain_gate:
+                    return jax.device_get(self._buf)
+        """,
+        """
+        import threading
+        import jax
+
+        class DrainGate:
+            def __init__(self):
+                self._drain_gate = threading.Lock()  # graftlock: gate
+                self._buf = None
+
+            def drain(self):
+                with self._drain_gate:
+                    buf = self._buf
+                    self._buf = None
+                return jax.device_get(buf)
+        """,
+    ),
+    (
+        # Rule 26: a timer armed while a lock is held whose callback
+        # re-acquires the same lock — if the timer can fire
+        # synchronously (or the armer joins it) this deadlocks. The
+        # good twin arms the timer after releasing the lock.
+        "lock-released-across-await-seam",
+        """
+        import threading
+
+        class Beat:
+            def __init__(self):
+                self._beat_lock = threading.Lock()
+                self.beats = 0
+
+            def arm(self):
+                with self._beat_lock:
+                    t = threading.Timer(1.0, self._fire)
+                    t.start()
+
+            def _fire(self):
+                with self._beat_lock:
+                    self.beats += 1
+        """,
+        """
+        import threading
+
+        class Beat:
+            def __init__(self):
+                self._beat_lock = threading.Lock()
+                self.beats = 0
+
+            def arm(self):
+                with self._beat_lock:
+                    interval = 1.0 + self.beats
+                t = threading.Timer(interval, self._fire)
+                t.start()
+
+            def _fire(self):
+                with self._beat_lock:
+                    self.beats += 1
+        """,
+    ),
+    (
+        # Rule 26 again: an executor submit under the refresh lock
+        # whose task transitively re-acquires it one call deep. The
+        # good twin submits after the lock is released.
+        "lock-released-across-await-seam",
+        """
+        import threading
+
+        class Loader:
+            def __init__(self, pool):
+                self._refresh_lock = threading.Lock()
+                self._pool = pool
+                self.step = 0
+
+            def kick(self):
+                with self._refresh_lock:
+                    self._pool.submit(self._reload)
+
+            def _reload(self):
+                self._commit()
+
+            def _commit(self):
+                with self._refresh_lock:
+                    self.step += 1
+        """,
+        """
+        import threading
+
+        class Loader:
+            def __init__(self, pool):
+                self._refresh_lock = threading.Lock()
+                self._pool = pool
+                self.step = 0
+
+            def kick(self):
+                with self._refresh_lock:
+                    stale = self.step
+                if stale >= 0:
+                    self._pool.submit(self._reload)
+
+            def _reload(self):
+                self._commit()
+
+            def _commit(self):
+                with self._refresh_lock:
+                    self.step += 1
+        """,
+    ),
 ]
 
 
@@ -1314,6 +1653,20 @@ def test_package_scan_covers_train_modules():
     scenarios = {f.name for f in files if "scenarios" in f.parts}
     assert "schedule.py" in scenarios, (
         f"scenarios/schedule.py missing from the scan: {scenarios}"
+    )
+
+
+def test_package_scan_covers_analysis_engine():
+    """The zero-violation pin must include the analysis package itself
+    — the call-graph engine walks every other plane's locks, so its own
+    source stays under the same discipline it enforces."""
+    from marl_distributedformation_tpu.analysis import load_config
+    from marl_distributedformation_tpu.analysis.linter import iter_python_files
+
+    files = list(iter_python_files([PACKAGE], load_config(REPO), root=REPO))
+    analysis = {f.name for f in files if "analysis" in f.parts}
+    assert {"callgraph.py", "linter.py", "graftlock.py"} <= analysis, (
+        f"analysis/ engine missing from the lint scan: {analysis}"
     )
 
 
@@ -1562,3 +1915,79 @@ def test_cli_check_fails_on_violation(tmp_path):
     )
     assert out.returncode == 1, out.stdout + out.stderr
     assert "host-sync-in-jit" in out.stdout
+
+
+def test_cli_sarif_output_shape(tmp_path):
+    """--format sarif emits a SARIF 2.1.0 document: schema + version,
+    the full rule catalogue in the driver, and per-result ruleId /
+    level / physical location. A lock-ordering result's message must
+    carry the complete acquisition chain."""
+    (tmp_path / "cycle.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self.a_lock = threading.Lock()
+                    self.b_lock = threading.Lock()
+                    self.c_lock = threading.Lock()
+
+                def ab(self):
+                    with self.a_lock:
+                        with self.b_lock:
+                            pass
+
+                def bc(self):
+                    with self.b_lock:
+                        with self.c_lock:
+                            pass
+
+                def ca(self):
+                    with self.c_lock:
+                        with self.a_lock:
+                            pass
+            """
+        )
+    )
+    out = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "scripts" / "graftlint.py"),
+            "--format",
+            "sarif",
+            str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)  # stdout is ONLY the document
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    ids = [r["id"] for r in driver["rules"]]
+    assert ids == rule_names()
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"]
+        assert r["defaultConfiguration"]["level"] in ("error", "warning")
+    results = run["results"]
+    assert results, "the seeded cycle must produce at least one result"
+    by_rule = {r["ruleId"]: r for r in results}
+    cycle = by_rule["lock-ordering-cycle"]
+    assert cycle["level"] == "error"
+    assert cycle["ruleIndex"] == ids.index("lock-ordering-cycle")
+    text = cycle["message"]["text"]
+    # Full acquisition chain: all three edges, each with its site.
+    assert text.count("holding") == 3
+    for lock in ("a_lock", "b_lock", "c_lock"):
+        assert lock in text
+    assert "cycle.py:" in text
+    loc = cycle["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("cycle.py")
+    assert loc["region"]["startLine"] >= 1
+    assert loc["region"]["startColumn"] >= 1

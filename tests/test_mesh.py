@@ -167,6 +167,47 @@ def test_gossip_suspect_to_dead_timing_and_revival():
         coord.stop()
 
 
+def test_sweep_emits_death_incident_outside_hosts_lock():
+    """Regression: the ``mesh_host_dead`` incident dump (tracer ring
+    lock + a flight-recorder file write) must run AFTER ``_hosts_lock``
+    is released — it used to fire from inside the sweep's host walk,
+    nesting the tracer's lock (and its IO) under the lock every
+    heartbeat RPC dispatches through. The dead_reason verdict write
+    itself stays under the lock."""
+    from marl_distributedformation_tpu.obs import get_tracer
+
+    coord = MeshCoordinator(lease_s=0.01, dead_after_s=0.01)
+    coord._rpc_register(
+        {
+            "host_id": "h0",
+            "control_url": "http://127.0.0.1:1",
+            "data_url": "http://127.0.0.1:2",
+            "step": 100,
+        }
+    )
+    time.sleep(0.05)  # walk h0 past suspect into dead
+    tracer = get_tracer()
+    lock_states = []
+    original = tracer.incident
+
+    def spy(name, **fields):
+        if name == "mesh_host_dead":
+            lock_states.append(coord._hosts_lock.locked())
+        return original(name, **fields)
+
+    tracer.incident = spy
+    try:
+        coord.sweep()
+    finally:
+        tracer.incident = original
+    assert lock_states == [False], (
+        "the death incident must be emitted after the host-table lock "
+        f"is released: {lock_states}"
+    )
+    # The verdict itself landed (written under the lock, once).
+    assert "lease expired" in coord.hosts()[0]["dead_reason"]
+
+
 def test_stale_host_quarantined_until_caught_up():
     """A host serving BEHIND the mesh step must be unroutable (routing
     to it would serve an old model_step after newer responses) until
