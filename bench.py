@@ -99,6 +99,15 @@ Measures, inside one process and one JSON line:
   pays; check_bench_record.py holds it under a ceiling so the
   whole-package analyses (lock-ordering cycles, guarded-write DFS)
   cannot quietly go super-linear as the repo grows.
+- ``sebulba_env_steps_per_sec`` / ``sebulba_learner_steps_per_sec`` /
+  ``transfer_queue_occupancy_p95`` / ``param_staleness_p95_updates`` /
+  ``gate_eval_p50_under_load_s``: the sebulba lane (train/sebulba/,
+  docs/sebulba.md) — one pipelined actor/learner run with the bounded
+  TransferQueue between the slices, per-slice budget-1 compile
+  receipts (``sebulba_actor_compiles`` / ``sebulba_learner_compiles``
+  MUST be 1), and the promotion gate evaluating live checkpoints from
+  its OWN slice while the learner is saturated (steady-state eval
+  wall, post-compile).
 
 Phases skipped via
   ``BENCH_SKIP_*`` env vars record the explicit ``"skipped"`` sentinel
@@ -133,7 +142,9 @@ BENCH_TELEMETRY_PASSES, BENCH_SENTINEL_CHECKS, BENCH_SKIP_CHAOS=1,
 BENCH_CHAOS_SEED, BENCH_CHAOS_FAULTS, BENCH_LEDGER_CHUNK,
 BENCH_LEDGER_PASSES (the ledger phase shares BENCH_SKIP_TRAIN),
 BENCH_SKIP_MESH=1, BENCH_MESH_HOSTS, BENCH_MESH_DURATION_S,
-BENCH_MESH_SWAPS, BENCH_SKIP_LINT=1, BENCH_LINT_TIMEOUT_S.
+BENCH_MESH_SWAPS, BENCH_SKIP_LINT=1, BENCH_LINT_TIMEOUT_S,
+BENCH_SKIP_SEBULBA=1, BENCH_SEBULBA_M, BENCH_SEBULBA_ITERS,
+BENCH_SEBULBA_CHUNK.
 
 Prints exactly one JSON line with at least:
     {"metric": ..., "value": N, "unit": "env-steps/s", "vs_baseline": N}
@@ -2361,6 +2372,195 @@ def main() -> None:
                 )
         else:
             notes.append("lint phase skipped: deadline")
+
+        # --- Phase 17: the sebulba lane (train/sebulba/,
+        # docs/sebulba.md). One pipelined actor/learner run at bench
+        # scale: the actor thread streams rollouts through the bounded
+        # TransferQueue while the learner drains K per fused chunk —
+        # headlines sebulba_env_steps_per_sec (actor-side env
+        # interaction wall rate), sebulba_learner_steps_per_sec
+        # (batches consumed into updates per second), the queue /
+        # staleness p95s, and the per-slice budget-1 compile receipts.
+        # While the learner is SATURATED, the promotion gate — pinned
+        # to its own slice via assign_gate_device — evaluates live
+        # checkpoints: gate_eval_p50_under_load_s is the steady-state
+        # (post-compile) eval wall beside a busy learner, the number
+        # the gate's latency budget is written against.
+        sebulba_fields = (
+            "sebulba_env_steps_per_sec",
+            "sebulba_learner_steps_per_sec",
+            "transfer_queue_occupancy_p95",
+            "param_staleness_p95_updates",
+            "sebulba_actor_compiles",
+            "sebulba_learner_compiles",
+            "gate_eval_p50_under_load_s",
+        )
+        if os.environ.get("BENCH_SKIP_SEBULBA") == "1":
+            _mark_skipped(result, "sebulba", sebulba_fields)
+        elif time.time() < deadline - 30:
+            try:
+                import tempfile
+                import threading as _threading
+
+                from marl_distributedformation_tpu.algo import PPOConfig
+                from marl_distributedformation_tpu.pipeline import (
+                    GateConfig,
+                    PromotionGate,
+                )
+                from marl_distributedformation_tpu.train import (
+                    SebulbaDriver,
+                    TrainConfig,
+                    assign_gate_device,
+                )
+                from marl_distributedformation_tpu.utils.checkpoint import (
+                    latest_checkpoint,
+                )
+
+                seb_m = _env_int("BENCH_SEBULBA_M", 64)
+                seb_iters = _env_int("BENCH_SEBULBA_ITERS", 24)
+                seb_chunk = _env_int("BENCH_SEBULBA_CHUNK", 2)
+                seb_dir = tempfile.mkdtemp(prefix="bench_sebulba_")
+                seb_env = EnvParams(num_agents=N)
+                per_iter = 5 * seb_m * N
+                driver = SebulbaDriver(
+                    seb_env,
+                    ppo=PPOConfig(n_steps=5, n_epochs=2, batch_size=64),
+                    config=TrainConfig(
+                        num_formations=seb_m,
+                        total_timesteps=seb_iters * per_iter,
+                        save_freq=4,
+                        fused_chunk=seb_chunk,
+                        name="bench_sebulba",
+                        log_dir=seb_dir,
+                        seed=0,
+                        architecture="sebulba",
+                    ),
+                )
+                t0 = time.perf_counter()
+                train_box: list = []
+                train_thread = _threading.Thread(
+                    target=lambda: train_box.append(driver.train()),
+                    name="bench-sebulba-train",
+                    daemon=True,
+                )
+                train_thread.start()
+                # Gate-beside-learner leg: wait for the run's first
+                # checkpoint, then evaluate it from THIS thread on the
+                # gate's own slice while the learner chews. One warm
+                # eval absorbs the matrix compile (the gate's budget-1
+                # bootstrap, not its steady state); the timed evals are
+                # the under-load latency the budget is written against.
+                gate_device = assign_gate_device(1)
+                gate = PromotionGate(
+                    seb_env,
+                    GateConfig(
+                        scenarios=("wind",),
+                        severities=(1.0,),
+                        eval_formations=8,
+                        clean_tolerance=10.0,
+                        rung_tolerance=10.0,
+                    ),
+                    device=gate_device,
+                )
+                candidate = None
+                gate_deadline = min(deadline, time.time() + 120)
+                while time.time() < gate_deadline and candidate is None:
+                    candidate = latest_checkpoint(seb_dir)
+                    if candidate is None:
+                        time.sleep(0.2)
+                gate_walls = []
+                if candidate is not None:
+                    gate.evaluate(candidate)  # warm: compile + baseline
+                    for _ in range(5):
+                        if (
+                            time.time() > deadline
+                            or not train_thread.is_alive()
+                        ):
+                            break
+                        fresh = latest_checkpoint(seb_dir) or candidate
+                        g0 = time.perf_counter()
+                        gate.evaluate(fresh)
+                        gate_walls.append(time.perf_counter() - g0)
+                    if not gate_walls and time.time() < deadline:
+                        # The run outran the gate's warm compile (short
+                        # bench budgets) — still record the steady-state
+                        # eval wall, honestly annotated: the learner was
+                        # idle for these.
+                        notes.append(
+                            "sebulba gate evals ran after the learner "
+                            "finished (run shorter than the gate's "
+                            "warm compile)"
+                        )
+                        for _ in range(3):
+                            fresh = latest_checkpoint(seb_dir) or candidate
+                            g0 = time.perf_counter()
+                            gate.evaluate(fresh)
+                            gate_walls.append(time.perf_counter() - g0)
+                else:
+                    notes.append(
+                        "sebulba gate leg skipped: no checkpoint "
+                        "appeared before the gate deadline"
+                    )
+                train_thread.join(
+                    timeout=max(10.0, deadline - time.time() + 60)
+                )
+                wall = time.perf_counter() - t0
+                if train_thread.is_alive() or not train_box:
+                    notes.append(
+                        "sebulba phase failed: pipelined run did not "
+                        "finish inside the bench deadline"
+                    )
+                else:
+                    queue = driver.transfer_queue
+                    result["sebulba_env_steps_per_sec"] = round(
+                        driver.num_timesteps / wall, 1
+                    )
+                    result["sebulba_learner_steps_per_sec"] = round(
+                        len(queue.consumed_seqs) / wall, 2
+                    )
+                    result["transfer_queue_occupancy_p95"] = round(
+                        driver.occupancy_p95(), 2
+                    )
+                    result["param_staleness_p95_updates"] = round(
+                        driver.staleness_p95(), 2
+                    )
+                    result["sebulba_actor_compiles"] = int(
+                        driver.actor_guard.count
+                    )
+                    result["sebulba_learner_compiles"] = int(
+                        driver.learner_guard.count
+                    )
+                    result["sebulba_stale_dropped"] = int(
+                        driver.stale_dropped
+                    )
+                    result["sebulba_gate_device"] = str(gate_device)
+                    if gate_walls:
+                        result["gate_eval_p50_under_load_s"] = round(
+                            sorted(gate_walls)[len(gate_walls) // 2], 4
+                        )
+                        result["sebulba_gate_compiles"] = int(
+                            gate.program.compile_count
+                            if gate.program is not None
+                            else 0
+                        )
+                    print(
+                        "[bench] sebulba (pipelined, chunk="
+                        f"{seb_chunk}): "
+                        f"{result['sebulba_env_steps_per_sec']:,.0f} "
+                        "env-steps/s acted, "
+                        f"{result['sebulba_learner_steps_per_sec']:.1f} "
+                        "batches/s learned, occupancy p95 "
+                        f"{result['transfer_queue_occupancy_p95']}, "
+                        "staleness p95 "
+                        f"{result['param_staleness_p95_updates']}, gate "
+                        f"p50 {result.get('gate_eval_p50_under_load_s')}"
+                        f"s on {gate_device}",
+                        file=sys.stderr,
+                    )
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                notes.append(f"sebulba phase failed: {e!r}"[:200])
+        else:
+            notes.append("sebulba phase skipped: deadline")
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         result["error"] = repr(e)[:300]
     if notes:

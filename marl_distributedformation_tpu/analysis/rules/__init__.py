@@ -5,6 +5,9 @@ rule serves every lint run."""
 from typing import List
 
 from marl_distributedformation_tpu.analysis.linter import Rule
+from marl_distributedformation_tpu.analysis.rules.actor_transfer import (
+    BlockingTransferInActorLoop,
+)
 from marl_distributedformation_tpu.analysis.rules.callbacks import (
     CallbackInHotLoop,
 )
@@ -93,6 +96,7 @@ RULES = (
     UnguardedSharedMutation(),
     BlockingCallUnderDispatchLock(),
     LockReleasedAcrossAwaitSeam(),
+    BlockingTransferInActorLoop(),
 )
 
 

@@ -1,0 +1,154 @@
+"""blocking-transfer-in-actor-loop: a sync on the acting critical path.
+
+The sebulba split (train/sebulba/, docs/sebulba.md) only pays off while
+the actor lane stays a pure dispatch pipeline: snapshot params, launch
+the compiled rollout, enqueue the trajectory, repeat. jax keeps that
+pipeline deep by dispatching asynchronously — which a single synchronous
+transfer collapses::
+
+    while not stop:                       # the actor loop
+        batch = rollout(params, state)
+        jax.block_until_ready(batch)      # <- actor idles out the device
+        queue.put(jax.device_get(batch))  # <- full device->host round trip
+
+``block_until_ready`` stalls the lane until the device drains (the
+learner's backpressure already paces the actor — a second, synchronous
+pacing point just serializes the two slices), ``device_get`` drags the
+trajectory through host memory that the learner slice would have
+received device-to-device, and a bare host ``device_put`` re-uploads
+per iteration what the queue's enqueue seam places once per batch
+(``train/sebulba/queues.py`` — the sanctioned home, deliberately
+OUTSIDE its backpressure loop). The fix is always the seam: hand the
+device tree to the ``TransferQueue`` and let its enqueue-time
+``device_put`` overlap the next rollout; drain metrics at the learner's
+amortized chunk boundary, never in the acting loop.
+
+Scope, deliberately narrow: host-side ``while``/``for`` loops (traced
+loops are rule 2's report) whose enclosing function or class name
+contains ``actor`` or ``transfer`` — the naming convention of every
+acting/transfer lane in this repo. Flagged inside such a loop body:
+
+- ``jax.device_get`` / ``jax.device_put`` / ``jax.block_until_ready``
+  dotted calls (or their from-imported plain names);
+- ``x.block_until_ready()`` method spellings (the call IS the sync,
+  whatever the receiver);
+- a plain-name call into a SAME-MODULE helper that makes one of those
+  calls — one hop on the shared call graph (``first_hops={"local"}``,
+  rules 12/16 precedent). Method calls are not followed: the
+  TransferQueue/ParamBus seams are methods invoked from actor loops,
+  and following them would flag exactly the off-critical-path homes
+  this rule exists to steer toward.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from marl_distributedformation_tpu.analysis import callgraph
+from marl_distributedformation_tpu.analysis.linter import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+_BLOCKING_CALLS = frozenset(
+    {
+        "jax.device_get",
+        "device_get",
+        "jax.device_put",
+        "device_put",
+        "jax.block_until_ready",
+        "block_until_ready",
+    }
+)
+_SCOPE_MARKERS = ("actor", "transfer")
+_NAME_HOPS = frozenset({"local"})
+
+
+def _blocking_pred(node: ast.Call, fname) -> Optional[str]:
+    if fname in _BLOCKING_CALLS:
+        return fname
+    if isinstance(node.func, ast.Attribute) and (
+        node.func.attr == "block_until_ready"
+    ):
+        return ".block_until_ready"
+    return None
+
+
+class BlockingTransferInActorLoop(Rule):
+    name = "blocking-transfer-in-actor-loop"
+    default_severity = "error"
+    description = (
+        "synchronous device_get/device_put/block_until_ready inside an "
+        "actor or transfer-queue loop body — a device sync per rollout "
+        "on the acting critical path; hand the device tree to the "
+        "transfer-queue seam and keep the lane asynchronous"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        reported: Set[Tuple[int, int]] = set()
+        for loop in self._actor_loops(ctx):
+            for hit in self._scan_body(ctx, loop):
+                if hit[:2] not in reported:
+                    reported.add(hit[:2])
+                    yield hit
+
+    def _actor_loops(self, ctx: ModuleContext) -> List[ast.AST]:
+        """Host while/for loops whose enclosing function or class name
+        marks an acting/transfer lane. Nested loops each appear; the
+        reported set keeps one report per call site."""
+        return [
+            node
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.While, ast.For))
+            and not ctx._has_traced_ancestor(node)
+            and self._in_actor_scope(ctx, node)
+        ]
+
+    @staticmethod
+    def _in_actor_scope(ctx: ModuleContext, loop: ast.AST) -> bool:
+        for anc in ctx._ancestors(loop):
+            if isinstance(
+                anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                name = anc.name.lower()
+                if any(marker in name for marker in _SCOPE_MARKERS):
+                    return True
+        return False
+
+    def _scan_body(
+        self, ctx: ModuleContext, loop: ast.AST
+    ) -> Iterator[Tuple[int, int, str]]:
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.enclosing_traced_scope(node) is not None:
+                continue  # a jitted helper defined inside the loop
+            fname = dotted_name(node.func)
+            direct = _blocking_pred(node, fname)
+            if direct is not None:
+                yield (
+                    node.lineno,
+                    node.col_offset,
+                    f"{direct}(...) inside an actor/transfer loop "
+                    "synchronizes the acting lane every iteration — "
+                    "enqueue the device tree through the transfer-queue "
+                    "seam (its enqueue-time device_put overlaps the next "
+                    "rollout) and drain host values at the learner's "
+                    "chunk boundary",
+                )
+            elif isinstance(node.func, ast.Name):
+                hit = callgraph.reachable_call(
+                    ctx, node, _blocking_pred, first_hops=_NAME_HOPS
+                )
+                if hit is not None:
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        f"{node.func.id}() is called from an "
+                        f"actor/transfer loop and reaches "
+                        f"{hit.matched}(...) — a device sync per "
+                        "iteration on the acting critical path; move the "
+                        "transfer to the queue's enqueue seam",
+                    )

@@ -19,11 +19,14 @@ campaign and reports MTTR + violations as one JSON line.
 from marl_distributedformation_tpu.chaos.invariants import (
     Violation,
     check_audit_log,
+    check_bounded_staleness,
     check_budget_one,
     check_checkpoint_dir,
     check_final_params_finite,
     check_finite_checkpoints,
+    check_no_duplicate_consume,
     check_no_request_lost,
+    check_params_version_monotone,
     check_recovery_log,
     check_step_monotonic,
     report_violations,
@@ -62,11 +65,14 @@ __all__ = [
     "SimulatedCrash",
     "Violation",
     "check_audit_log",
+    "check_bounded_staleness",
     "check_budget_one",
     "check_checkpoint_dir",
     "check_final_params_finite",
     "check_finite_checkpoints",
+    "check_no_duplicate_consume",
     "check_no_request_lost",
+    "check_params_version_monotone",
     "check_recovery_log",
     "check_step_monotonic",
     "configure_chaos",

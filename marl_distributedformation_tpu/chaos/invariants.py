@@ -386,6 +386,87 @@ def check_recovery_log(
     return violations
 
 
+def check_no_duplicate_consume(
+    consumed_seqs: Sequence[int],
+) -> List[Violation]:
+    """Sebulba transfer contract (docs/sebulba.md): no trajectory batch
+    is ever consumed twice. ``consumed_seqs`` is the TransferQueue's
+    consume-order artifact; the chaos ``sebulba.dequeue`` seam redelivers
+    items, so the queue's seq guard must leave this STRICTLY increasing
+    — a repeat or regression means a duplicate reached the learner
+    (the same batch counted into two updates)."""
+    violations: List[Violation] = []
+    prev: Optional[int] = None
+    for i, seq in enumerate(consumed_seqs):
+        seq = int(seq)
+        if prev is not None and seq <= prev:
+            violations.append(
+                Violation(
+                    "no_duplicate_consume",
+                    f"consume order position {i}: seq {seq} after {prev} "
+                    "— a redelivered trajectory batch reached the "
+                    "learner twice (the queue's seq guard failed)",
+                    {"position": i, "seq": seq, "prev": prev},
+                )
+            )
+        prev = seq
+    return violations
+
+
+def check_params_version_monotone(
+    consumed_versions: Sequence[int],
+) -> List[Violation]:
+    """Sebulba params contract: the ``params_version`` stamped on
+    consumed batches never goes BACKWARD — the ParamBus is single-slot
+    latest-wins, so an actor can act on stale params (dropped publish)
+    but never on a version older than one it already acted with. A
+    regression here means the bus swapped backward or a stale batch
+    outlived the staleness gate out of order."""
+    violations: List[Violation] = []
+    prev: Optional[int] = None
+    for i, version in enumerate(consumed_versions):
+        version = int(version)
+        if prev is not None and version < prev:
+            violations.append(
+                Violation(
+                    "params_version_monotone",
+                    f"consume order position {i}: params_version "
+                    f"{version} after {prev} — the latest-wins bus "
+                    "regressed (an older snapshot overwrote a newer one)",
+                    {"position": i, "version": version, "prev": prev},
+                )
+            )
+        prev = version
+    return violations
+
+
+def check_bounded_staleness(
+    staleness_samples: Sequence[int],
+    max_param_staleness: int,
+) -> List[Violation]:
+    """Sebulba staleness contract: every batch the learner CONSUMED was
+    acted with params at most ``max_param_staleness`` updates behind the
+    learner's current version — the driver's staleness gate must drop
+    (never train on) anything older, even while the chaos
+    ``sebulba.param_publish`` seam is holding publishes back."""
+    violations: List[Violation] = []
+    bound = int(max_param_staleness)
+    for i, staleness in enumerate(staleness_samples):
+        staleness = int(staleness)
+        if staleness > bound:
+            violations.append(
+                Violation(
+                    "bounded_staleness",
+                    f"consumed batch {i} was acted {staleness} params "
+                    f"versions behind the learner (bound: {bound}) — "
+                    "the staleness gate let an over-stale trajectory "
+                    "into an update",
+                    {"position": i, "staleness": staleness, "bound": bound},
+                )
+            )
+    return violations
+
+
 def report_violations(
     violations: Sequence[Violation],
     plane: Optional[FaultPlane] = None,

@@ -93,6 +93,22 @@ INJECTION_POINTS: Dict[str, Tuple[str, ...]] = {
     #   non-finite write gate (utils/checkpoint.py) must keep it
     #   invisible to discovery.
     "train.snapshot": ("raise", "delay"),
+    # train/sebulba/queues.py — the transfer seams between the actor
+    # and learner slices (docs/sebulba.md). Each seam CATCHES an armed
+    # 'raise' and interprets it as that seam's characteristic transport
+    # failure; the lane invariants (chaos/invariants.py) then pin that
+    # the plumbing degrades instead of corrupting.
+    #   enqueue: DROP — the trajectory batch vanishes in transfer (its
+    #     seq is spent: downstream sees a gap, never a duplicate).
+    "sebulba.enqueue": ("raise", "delay"),
+    #   dequeue: DUPLICATE — the delivered item is re-queued at the
+    #     head (a retrying-consumer bug's shape); the queue's seq guard
+    #     must absorb the redelivery (no trajectory consumed twice).
+    "sebulba.dequeue": ("raise", "delay"),
+    #   param_publish: STALE PARAMS — the learner's publish is dropped,
+    #     actors keep acting on the previous version; the learner's
+    #     staleness gate bounds how old a consumed batch may be.
+    "sebulba.param_publish": ("raise", "delay"),
     # pipeline/stream.CheckpointStream.poll.
     "stream.poll": ("raise", "delay"),
     # pipeline/gate.PromotionGate eval body (runs on the gate's thread,

@@ -239,10 +239,18 @@ class PromotionGate:
     """
 
     def __init__(
-        self, env_params: EnvParams, config: GateConfig = GateConfig()
+        self,
+        env_params: EnvParams,
+        config: GateConfig = GateConfig(),
+        device=None,
     ) -> None:
         self.env_params = env_params
         self.config = config
+        # Slice assignment (train/sebulba, docs/sebulba.md): pin the
+        # gate's compiled programs to this device so candidate evals run
+        # beside — not interleaved with — the learner's update stream.
+        # None keeps jax's default placement (the Anakin time-share).
+        self.device = device
         self.program = None  # scenarios.matrix.MatrixProgram, lazy
         self.adversary = None  # scenarios.adversary.AdversarySearch, lazy
         self._baseline_step: Optional[int] = None  # graftlock: guarded-by=_eval_lock
@@ -389,6 +397,7 @@ class PromotionGate:
                     num_formations=cfg.eval_formations,
                     deterministic=cfg.deterministic,
                     seed=cfg.eval_seed,
+                    device=self.device,
                 )
             t0 = time.perf_counter()
             # The span wraps the compiled MatrixProgram calls from the
@@ -433,6 +442,7 @@ class PromotionGate:
                             seed=cfg.eval_seed,
                             deterministic=cfg.deterministic,
                         ),
+                        device=self.device,
                     )
                 with get_tracer().span(
                     "gate.adversary_search", trace_id=trace_id, step=step,
@@ -532,6 +542,12 @@ class PromotionGate:
             self._baseline_cells = cells
 
     # -- observability ---------------------------------------------------
+
+    def device_str(self) -> Optional[str]:
+        """The assigned eval device as a stable label (None = default
+        placement) — the promotion span breakdown records which slice
+        served each gate eval."""
+        return str(self.device) if self.device is not None else None
 
     def eval_steps_per_sec(self) -> float:
         """Gate throughput in formation-env-steps evaluated per second

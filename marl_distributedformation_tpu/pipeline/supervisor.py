@@ -111,6 +111,7 @@ class AlwaysLearningPipeline:
         poll_interval_s: float = 0.25,
         start_after_step: int = -1,
         feedback_rollouts: int = 50,
+        gate_device=None,
     ) -> None:
         self.log_dir = Path(log_dir)
         self.env_params = env_params  # sized requests (first-serve probe)
@@ -119,7 +120,11 @@ class AlwaysLearningPipeline:
             poll_interval_s=poll_interval_s,
             start_after_step=start_after_step,
         )
-        self.gate = PromotionGate(env_params, gate_config)
+        # gate_device: the gate's own device-slice assignment
+        # (train/sebulba's partition — docs/sebulba.md). The promotion
+        # span breakdown and the verdict log then record which slice
+        # served each eval.
+        self.gate = PromotionGate(env_params, gate_config, device=gate_device)
         self.promoted_dir = Path(
             promoted_dir if promoted_dir is not None
             else self.log_dir / "promoted"
@@ -229,7 +234,11 @@ class AlwaysLearningPipeline:
                 t_gate_start - tr.t_write
             )
         t0 = time.perf_counter()
-        with tracer.span("promotion.gate_eval", trace_id=tr.trace_id):
+        with tracer.span(
+            "promotion.gate_eval",
+            trace_id=tr.trace_id,
+            device=self.gate.device_str(),
+        ):
             verdict = self.gate.evaluate(path, trace_id=tr.trace_id)
         gate_eval_s = time.perf_counter() - t0
         tr.add("gate_eval_s", gate_eval_s)
@@ -719,6 +728,10 @@ class AlwaysLearningPipeline:
 
         return {
             "promotion_span_breakdown": breakdown,
+            # Which device-slice served the gate evals (None = default
+            # placement / Anakin time-share) — pairs with the breakdown's
+            # gate_eval_s so a latency report names its silicon.
+            "gate_device": self.gate.device_str(),
             "promotions": len(self.promotions),
             "rejections": len(self.rejections),
             "rollbacks": len(self.rollbacks),

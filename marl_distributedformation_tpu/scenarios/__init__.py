@@ -47,6 +47,7 @@ from marl_distributedformation_tpu.scenarios.matrix import (  # noqa: F401
 from marl_distributedformation_tpu.scenarios.adversary import (  # noqa: F401
     AdversaryConfig,
     AdversarySearch,
+    ContinuousAdversary,
     Falsifier,
     make_population_runner,
 )

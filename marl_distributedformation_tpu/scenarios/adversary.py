@@ -209,9 +209,14 @@ class AdversarySearch:
         env_params: EnvParams,
         config: AdversaryConfig = AdversaryConfig(),
         max_traces: Optional[int] = 1,
+        device=None,
     ) -> None:
         self.env_params = env_params
         self.config = config
+        # Slice assignment (train/sebulba): committed inputs pin the
+        # population program to ``device`` so the search runs beside —
+        # not on — the learner slice. None = default placement.
+        self.device = device
         names = config.scenarios or tuple(
             n for n in registered_scenarios() if n != "clean"
         )
@@ -232,6 +237,8 @@ class AdversarySearch:
             max_traces,
         )
         self.key = jax.random.PRNGKey(config.seed)
+        if device is not None:
+            self.key = jax.device_put(self.key, device)
         self._signature: Optional[Tuple] = None
         self.candidates_evaluated = 0
         self.search_seconds_total = 0.0
@@ -268,6 +275,8 @@ class AdversarySearch:
         padded = list(rows) + [
             (self._clean_spec, 0.0) for _ in range(self.population - len(rows))
         ]
+        if self.device is not None:
+            params = jax.device_put(params, self.device)
         out = self.run(self.key, params, _stack_rows(padded))
         metric = out.get(self.config.metric)
         if metric is None:
@@ -439,3 +448,172 @@ class AdversarySearch:
         if self.search_seconds_total <= 0:
             return 0.0
         return self.candidates_evaluated / self.search_seconds_total
+
+
+class ContinuousAdversary:
+    """Falsifier search as a CONTINUOUS lane over the live checkpoint
+    stream — outside the promotion gate's latency budget.
+
+    The gate's adversarial rung (``GateConfig.adversarial``) runs the
+    search inline per candidate, which puts generations x population
+    eval dispatches on the promotion critical path. This wrapper moves
+    the same search off that path: it tails a trainer's checkpoint
+    directory (``utils.checkpoint.latest_checkpoint`` — always the
+    newest, skipping intermediates; worst-case coverage matters more
+    than per-checkpoint coverage), attacks each new checkpoint with ONE
+    long-lived :class:`AdversarySearch` (budget-1 compile receipt across
+    every checkpoint it ever judges), and feeds discovered falsifiers
+    back through ``on_schedule`` as a ``from_falsifiers`` curriculum
+    stage — the train -> falsify -> train loop, decoupled from
+    promotion. With a sebulba trainer the scenario seam applies the new
+    schedule at the next actor dispatch with ZERO train-program
+    recompiles (severity and knobs are traced inputs).
+
+    ``device`` pins the search's compiled program to its own slice
+    (train/sebulba's gate/adversary assignment) so continuous attacking
+    never contends with the learner. Drive it deterministically with
+    :meth:`poll_once` (tests, campaigns) or as a daemon via
+    :meth:`run`/:meth:`stop`.
+    """
+
+    def __init__(
+        self,
+        log_dir,
+        env_params: EnvParams,
+        config: AdversaryConfig = AdversaryConfig(),
+        device=None,
+        on_schedule=None,
+        feedback_rollouts: int = 50,
+    ) -> None:
+        from pathlib import Path
+
+        self.log_dir = Path(log_dir)
+        self.env_params = env_params
+        self.config = config
+        self.device = device
+        self.on_schedule = on_schedule
+        self.feedback_rollouts = int(feedback_rollouts)
+        self.search: Optional[AdversarySearch] = None  # lazy, budget-1
+        self.last_step = -1
+        self.reports: List[dict] = []
+        self.schedules_pushed = 0
+        self.errors: List[str] = []
+        self._stop = None  # threading.Event, created by run()
+        self._thread = None
+
+    def poll_once(self) -> Optional[dict]:
+        """Attack the newest unseen checkpoint; None when there is
+        nothing new. A bad candidate (corrupt file, architecture drift)
+        is a recorded error, never a dead lane. On discovered
+        falsifiers, pushes the feedback schedule through
+        ``on_schedule`` (advisory: a failing callback is recorded,
+        the lane keeps attacking)."""
+        from marl_distributedformation_tpu.compat.policy import LoadedPolicy
+        from marl_distributedformation_tpu.obs import get_registry
+        from marl_distributedformation_tpu.utils.checkpoint import (
+            checkpoint_step,
+            latest_checkpoint,
+        )
+
+        path = latest_checkpoint(self.log_dir)
+        if path is None:
+            return None
+        try:
+            step = checkpoint_step(path)
+        except ValueError:
+            return None
+        if step <= self.last_step:
+            return None
+        try:
+            pol = LoadedPolicy.from_checkpoint(
+                path,
+                act_dim=self.env_params.act_dim,
+                env_params=self.env_params,
+            )
+            if self.search is None:
+                self.search = AdversarySearch(
+                    pol.model,
+                    self.env_params,
+                    self.config,
+                    device=self.device,
+                )
+            report = self.search.search(pol.params, origin=str(path))
+        except Exception as e:  # noqa: BLE001 — a bad checkpoint must
+            # not kill the lane; the next one may be fine.
+            self.errors.append(f"{path.name}: {e!r}"[:300])
+            del self.errors[:-32]
+            self.last_step = step  # never re-attack a broken file
+            return None
+        self.last_step = step
+        report["step"] = step
+        self.reports.append(report)
+        registry = get_registry()
+        registry.counter("adversary_continuous_searches_total").inc()
+        registry.gauge("adversary_continuous_falsifiers").set(
+            float(len(report["falsifiers"]))
+        )
+        if report["falsifiers"] and self.on_schedule is not None:
+            from marl_distributedformation_tpu.scenarios.schedule import (
+                from_falsifiers,
+            )
+
+            try:
+                self.on_schedule(
+                    from_falsifiers(
+                        report["falsifiers"],
+                        rollouts=self.feedback_rollouts,
+                    )
+                )
+                self.schedules_pushed += 1
+            except Exception as e:  # noqa: BLE001 — feedback is advisory
+                self.errors.append(f"on_schedule: {e!r}"[:300])
+                del self.errors[:-32]
+        return report
+
+    # -- background lane -------------------------------------------------
+
+    def run(self, interval_s: float = 1.0) -> "ContinuousAdversary":
+        """Poll as a daemon thread every ``interval_s`` (the continuous
+        mode scripts/always_learning.py wires next to a sebulba run)."""
+        import threading
+
+        if self._thread is not None:
+            return self
+        self._stop = threading.Event()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001 — keep the lane up
+                    self.errors.append(repr(e)[:300])
+                    del self.errors[:-32]
+                self._stop.wait(interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="continuous-adversary", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+        self._thread = None
+
+    def summary(self) -> dict:
+        """Flat lane report (always_learning's JSON line picks it up)."""
+        return {
+            "adversary_searches": len(self.reports),
+            "adversary_last_step": self.last_step,
+            "adversary_schedules_pushed": self.schedules_pushed,
+            "adversary_falsifiers_last": (
+                len(self.reports[-1]["falsifiers"]) if self.reports else 0
+            ),
+            "adversary_compiles": (
+                self.search.compile_count if self.search is not None else 0
+            ),
+            "adversary_errors": list(self.errors),
+        }

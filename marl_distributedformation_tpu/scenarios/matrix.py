@@ -97,6 +97,7 @@ class MatrixProgram:
         deterministic: bool = True,
         seed: int = 1234,
         max_traces: Optional[int] = 1,
+        device=None,
     ) -> None:
         self.model = model
         self.env_params = env_params
@@ -106,7 +107,14 @@ class MatrixProgram:
         self.run, self.guard = make_matrix_runner(
             model, env_params, num_formations, deterministic, max_traces
         )
+        # Slice assignment (train/sebulba): a committed key pins the
+        # compiled program to ``device`` — candidates are device_put
+        # there per eval, so the gate never time-shares the learner's
+        # silicon. None = follow jax's default placement (Anakin mode).
+        self.device = device
         self.key = jax.random.PRNGKey(seed)
+        if device is not None:
+            self.key = jax.device_put(self.key, device)
         self._signature: Optional[Tuple] = None
 
     @property
@@ -138,6 +146,8 @@ class MatrixProgram:
         (pinned by tests/test_scenarios.py), through the SAME compiled
         program as every disturbed cell."""
         self.check_params(params, origin)
+        if self.device is not None:
+            params = jax.device_put(params, self.device)
         spec = get_scenario("clean")
         out = self.run(self.key, params, spec.build(jnp.float32(0.0)))
         return {k: float(v) for k, v in out.items()}
@@ -152,6 +162,8 @@ class MatrixProgram:
         """The full scenario x severity grid for one parameter tree:
         ``cells[scenario][f"{severity:g}"] -> metrics``."""
         self.check_params(params, origin)
+        if self.device is not None:
+            params = jax.device_put(params, self.device)
         specs = [get_scenario(str(name)) for name in scenarios]  # fail fast
         cells: Dict[str, Dict[str, Dict[str, float]]] = {}
         for spec in specs:

@@ -30,3 +30,10 @@ from marl_distributedformation_tpu.train.curriculum import (  # noqa: F401
 from marl_distributedformation_tpu.train.hetero_sweep import (  # noqa: F401
     HeteroSweepTrainer,
 )
+from marl_distributedformation_tpu.train.sebulba import (  # noqa: F401
+    ParamBus,
+    SebulbaDriver,
+    TransferQueue,
+    assign_gate_device,
+    partition_devices,
+)

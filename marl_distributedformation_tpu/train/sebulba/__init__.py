@@ -1,0 +1,37 @@
+"""Sebulba lane: split acting from learning (docs/sebulba.md).
+
+The Podracer paper's second architecture next to Anakin — an actor
+slice runs the compiled rollout program against published params
+snapshots, a learner slice drains K trajectory batches per fused update
+chunk, and hardened host-side plumbing (:class:`TransferQueue` /
+:class:`ParamBus`) connects them. Selected by
+``TrainConfig.architecture = "sebulba"``.
+"""
+
+from marl_distributedformation_tpu.train.sebulba.driver import (
+    SebulbaDriver,
+    assign_gate_device,
+    make_actor_rollout,
+    make_learner_chunk,
+    make_learner_health,
+    make_learner_update,
+    partition_devices,
+)
+from marl_distributedformation_tpu.train.sebulba.queues import (
+    ParamBus,
+    TransferItem,
+    TransferQueue,
+)
+
+__all__ = [
+    "ParamBus",
+    "SebulbaDriver",
+    "TransferItem",
+    "TransferQueue",
+    "assign_gate_device",
+    "make_actor_rollout",
+    "make_learner_chunk",
+    "make_learner_health",
+    "make_learner_update",
+    "partition_devices",
+]

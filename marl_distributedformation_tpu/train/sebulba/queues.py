@@ -1,0 +1,279 @@
+"""Host-side transfer plumbing for the Sebulba lane (docs/sebulba.md).
+
+Two primitives connect the actor slice to the learner slice:
+
+- :class:`TransferQueue` — a bounded FIFO of fixed-shape trajectory
+  batches. ``put`` blocks when the queue is full (backpressure: the
+  actor can never run more than ``depth`` rollouts ahead of the
+  learner), stamps every item with a monotone ``seq`` and the
+  ``params_version`` the rollout was acted with, and ``device_put``s
+  the payload onto the learner slice at ENQUEUE time — an async
+  device-to-device copy dispatched off the learner's critical path, so
+  the drain never pays the transfer. The consume side carries a seq
+  guard: a redelivered item (the chaos ``sebulba.dequeue`` seam
+  simulates a retry bug by re-queuing the item it just handed out) is
+  absorbed and counted, never consumed twice — the invariant
+  ``chaos.check_no_duplicate_consume`` pins over ``consumed_seqs``.
+
+- :class:`ParamBus` — the single-slot, latest-wins params channel back.
+  ``publish`` atomically swaps the slot under a lock and ignores
+  non-monotone versions (latest wins by construction); ``latest`` is
+  the atomic read the actor performs at its dispatch boundary. The
+  publish seam places the params onto the actor slice — the
+  once-per-version placement event rule 16 sanctions, so actor
+  dispatches reuse device-resident weights. A ``raise`` armed on
+  ``sebulba.param_publish`` drops the publish (the stale-params chaos
+  effect): actors keep acting on the previous version until the next
+  one lands, and the learner's staleness gate bounds the damage.
+
+Both ends record into the merged Prometheus namespace at host seams
+only (``transfer_queue_occupancy``, ``param_bus_version``, drop /
+duplicate counters) and keep small host-side artifact lists
+(``consumed_seqs``, ``occupancy_samples``) the chaos invariants and
+bench percentiles are computed from.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+from marl_distributedformation_tpu.chaos.plane import (
+    InjectedFault,
+    fault_point,
+)
+from marl_distributedformation_tpu.obs.metrics import get_registry
+
+#: Artifact ring bound: campaigns and bench runs are short, but a
+#: long-lived driver must not grow host lists without bound.
+_MAX_SAMPLES = 65536
+
+
+class TransferItem(NamedTuple):
+    """One queued trajectory batch: ``seq`` is the queue's monotone
+    enqueue stamp, ``params_version`` the :class:`ParamBus` version the
+    actor snapshot carried, ``payload`` the device tree
+    ``(batch, last_value, k_update)``."""
+
+    seq: int
+    params_version: int
+    payload: Any
+
+
+class TransferQueue:
+    """Bounded host-side queue between the actor and learner lanes."""
+
+    def __init__(
+        self,
+        depth: int,
+        learner_device: Any = None,
+        name: str = "transfer_queue",
+    ) -> None:
+        if depth < 1:
+            raise ValueError(
+                f"transfer_queue_depth must be >= 1, got {depth}"
+            )
+        self.depth = int(depth)
+        self.name = name
+        self._learner_device = learner_device
+        self._items: collections.deque = collections.deque()  # graftlock: guarded-by=_lock
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._not_empty = threading.Condition(self._lock)
+        self._next_seq = 0  # graftlock: guarded-by=_lock
+        self._last_consumed = -1  # graftlock: guarded-by=_lock
+        self._closed = False  # graftlock: guarded-by=_lock
+        # Campaign / bench artifacts (host ints only, bounded).
+        self.consumed_seqs: collections.deque = collections.deque(
+            maxlen=_MAX_SAMPLES
+        )
+        self.occupancy_samples: collections.deque = collections.deque(
+            maxlen=_MAX_SAMPLES
+        )
+        self.enqueued_total = 0
+        self.dropped_total = 0
+        self.duplicates_absorbed = 0
+        get_registry().gauge(f"{name}_depth").set(float(self.depth))
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def put(
+        self,
+        payload: Any,
+        params_version: int,
+        timeout_s: Optional[float] = None,
+    ) -> Optional[int]:
+        """Enqueue one trajectory batch; blocks while the queue is full
+        (the backpressure contract). Returns the assigned seq, or None
+        when the batch was dropped (queue closed, timeout expired, or
+        the ``sebulba.enqueue`` chaos seam fired — a dropped batch is a
+        seq GAP downstream, never a duplicate)."""
+        # Both conditions share self._lock; acquiring the lock directly
+        # keeps every guarded write visibly inside `with self._lock:`
+        # (the graftlock contract) while wait/notify still work — a
+        # Condition's wait releases and reacquires its backing lock.
+        with self._lock:
+            while len(self._items) >= self.depth and not self._closed:
+                if not self._not_full.wait(timeout=timeout_s):
+                    return None
+            if self._closed:
+                return None
+            seq = self._next_seq
+            self._next_seq += 1
+        try:
+            # Chaos seam (chaos/plane.py): an armed 'raise' is the DROP
+            # effect — the batch vanishes in transfer, the seq is spent.
+            fault_point("sebulba.enqueue")
+        except InjectedFault:
+            self.dropped_total += 1
+            get_registry().counter(
+                "sebulba_dropped_batches_total"
+            ).inc()
+            return None
+        if self._learner_device is not None:
+            # Device-to-device placement onto the learner slice, HERE at
+            # the enqueue seam: jax dispatches the copy asynchronously,
+            # so it overlaps the actor's next rollout instead of
+            # stalling the learner's drain (the off-critical-path
+            # contract; single-device runs skip it — see the driver).
+            import jax
+
+            payload = jax.device_put(payload, self._learner_device)
+        with self._lock:
+            self._items.append(TransferItem(seq, int(params_version), payload))
+            occupancy = len(self._items)
+            self._not_empty.notify()
+        self.enqueued_total += 1
+        self.occupancy_samples.append(occupancy)
+        get_registry().gauge(f"{self.name}_occupancy").set(float(occupancy))
+        return seq
+
+    def get(self, timeout_s: Optional[float] = None) -> Optional[TransferItem]:
+        """Dequeue the next batch; blocks up to ``timeout_s`` (None =
+        forever). Returns None on timeout or when the queue is closed
+        and drained. Redelivered items (seq already consumed) are
+        absorbed here — the consume-twice guard."""
+        while True:
+            item = self._pop(timeout_s)
+            if item is None:
+                return None
+            try:
+                # Chaos seam: an armed 'raise' is the DUPLICATE effect —
+                # the item is re-queued at the head (a redelivery bug's
+                # shape) while this delivery proceeds; the seq guard
+                # below absorbs the replay on the next get.
+                fault_point("sebulba.dequeue")
+            except InjectedFault:
+                with self._lock:
+                    self._items.appendleft(item)
+                    self._not_empty.notify()
+            with self._lock:
+                if item.seq <= self._last_consumed:
+                    duplicate = True
+                else:
+                    duplicate = False
+                    self._last_consumed = item.seq
+            if duplicate:
+                self.duplicates_absorbed += 1
+                get_registry().counter(
+                    "sebulba_duplicates_absorbed_total"
+                ).inc()
+                continue
+            self.consumed_seqs.append(item.seq)
+            get_registry().gauge(f"{self.name}_occupancy").set(
+                float(len(self))
+            )
+            return item
+
+    def _pop(self, timeout_s: Optional[float]) -> Optional[TransferItem]:
+        with self._lock:
+            while not self._items:
+                if self._closed:
+                    return None
+                if not self._not_empty.wait(timeout=timeout_s):
+                    return None
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
+
+    def close(self) -> None:
+        """Wake every blocked producer/consumer; puts fail from here on,
+        gets drain the remaining items then return None."""
+        with self._lock:
+            self._closed = True
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+
+
+class ParamBus:
+    """Single-slot, latest-wins params channel from learner to actors."""
+
+    def __init__(self, actor_device: Any = None) -> None:
+        self._actor_device = actor_device
+        self._lock = threading.Lock()
+        self._fresh = threading.Condition(self._lock)
+        self._version = -1  # graftlock: guarded-by=_lock
+        self._params: Any = None  # graftlock: guarded-by=_lock
+        self.publishes_dropped = 0
+        self.versions_published: List[int] = []
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def publish(self, params: Any, version: int) -> bool:
+        """Atomic slot swap. Returns False when the publish was dropped:
+        by the ``sebulba.param_publish`` chaos seam (the stale-params
+        effect — actors keep the previous version) or because a newer
+        version already holds the slot (latest wins; version regression
+        is structurally impossible at the actor)."""
+        try:
+            fault_point("sebulba.param_publish")
+        except InjectedFault:
+            self.publishes_dropped += 1
+            get_registry().counter(
+                "sebulba_param_publish_dropped_total"
+            ).inc()
+            return False
+        if self._actor_device is not None:
+            # Once-per-version placement onto the actor slice — the
+            # swap-seam home rule 16 sanctions for device_put; every
+            # actor dispatch then reuses the device-resident weights.
+            import jax
+
+            params = jax.device_put(params, self._actor_device)
+        with self._lock:
+            if version <= self._version:
+                return False
+            self._params = params
+            self._version = int(version)
+            if len(self.versions_published) < _MAX_SAMPLES:
+                self.versions_published.append(self._version)
+            self._fresh.notify_all()
+        get_registry().gauge("param_bus_version").set(float(version))
+        return True
+
+    def latest(self) -> Tuple[int, Any]:
+        """The atomic read at the actor dispatch boundary: the newest
+        ``(version, params)`` pair, swapped in one lock acquisition."""
+        with self._lock:
+            return self._version, self._params
+
+    def wait_version(
+        self, min_version: int, timeout_s: Optional[float] = None
+    ) -> bool:
+        """Block until the slot holds at least ``min_version`` (the
+        actor's staleness backstop when publishes are being dropped)."""
+        with self._lock:
+            return self._fresh.wait_for(
+                lambda: self._version >= min_version, timeout=timeout_s
+            )

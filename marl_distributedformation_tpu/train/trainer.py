@@ -130,6 +130,21 @@ class TrainConfig:
     #   newest N rl_model_* checkpoints (0 = unbounded, the legacy
     #   behavior). Quarantine-aware and never prunes the recovery
     #   ladder's current last-good rollback target.
+    # Sebulba lane (train/sebulba/, docs/sebulba.md): the split
+    # acting/learning architecture next to Anakin.
+    architecture: str = "anakin"  # "anakin" (fused same-device dispatch,
+    #   every mode above) | "sebulba" (actor slice + learner slice joined
+    #   by a bounded host-side TransferQueue and a latest-wins ParamBus;
+    #   fused_chunk is reinterpreted as K, the batches the learner drains
+    #   per fused update chunk)
+    actor_devices: int = 1  # sebulba: local devices assigned to the
+    #   actor slice (the remainder learn; at least one device is always
+    #   kept for the learner — a single-device host time-shares)
+    transfer_queue_depth: int = 2  # sebulba: bound on in-flight
+    #   trajectory batches; a full queue blocks the actor (backpressure),
+    #   so the actor can never run more than this many rollouts ahead
+    max_param_staleness: int = 2  # sebulba: drop (never train on) a
+    #   batch acted with params more than this many learner updates old
 
 
 def default_total_timesteps(config: "TrainConfig") -> int:

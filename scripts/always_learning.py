@@ -128,6 +128,11 @@ TRAIN_EXTRA_KEYS = (
     "curriculum", "learning_rates", "platform", "preset", "fused_chunk",
     "iters_per_dispatch", "guard_retraces", "guard_transfers",
     "guard_nans", "profile", "profile_iterations",
+    # sebulba lane (train/sebulba/, docs/sebulba.md): the split
+    # acting/learning architecture; the gate then runs on its OWN
+    # device slice instead of time-sharing the trainer's.
+    "architecture", "actor_devices", "transfer_queue_depth",
+    "max_param_staleness",
 )
 
 
@@ -242,14 +247,23 @@ def main(argv=None) -> dict:
     setup_platform(cfg.get("platform"))
 
     replicas = int(cfg.get("pipeline_replicas", 2))
+    sebulba = str(cfg.get("architecture") or "anakin") == "sebulba"
+    actor_devices = int(cfg.get("actor_devices", 1))
+    # Sebulba wants real slices: actor_devices acting + 1 learning + 1
+    # for the gate's own assignment (docs/sebulba.md). Anakin only needs
+    # a device per serving replica.
+    want_devices = max(replicas, actor_devices + 2) if sebulba else replicas
     import jax
 
-    if jax.default_backend() == "cpu" and len(jax.local_devices()) < replicas:
+    if (
+        jax.default_backend() == "cpu"
+        and len(jax.local_devices()) < want_devices
+    ):
         # The forced multi-device CPU mesh (the dev/bench shape): widen
         # the device pool so each serving replica gets a real device.
         from serve_policy import _ensure_cpu_devices
 
-        _ensure_cpu_devices(replicas)
+        _ensure_cpu_devices(want_devices)
 
     import train as train_entry
     from marl_distributedformation_tpu.pipeline import (
@@ -353,12 +367,27 @@ def main(argv=None) -> dict:
 
     budget_s = float(cfg.get("pipeline_budget_s", 600.0))
     deadline = time.time() + budget_s
+    gate_device = None
+    if sebulba:
+        # The gate's own slice under the sebulba partition — candidate
+        # evals stop contending with the learner's update stream, and
+        # the promotion span breakdown records which device served.
+        from marl_distributedformation_tpu.train import assign_gate_device
+
+        gate_device = assign_gate_device(actor_devices)
+        print(
+            f"[always] sebulba: actor slice {trainer.actor_slice}, "
+            f"learner slice {trainer.learner_slice}, gate on "
+            f"{gate_device}",
+            file=sys.stderr,
+        )
     pipeline = AlwaysLearningPipeline(
         trainer.log_dir,
         env_params,
         gate_config=_gate_config(cfg),
         poll_interval_s=float(cfg.get("pipeline_poll_s", 0.25)),
         feedback_rollouts=int(cfg.get("feedback_rollouts", 50)),
+        gate_device=gate_device,
     )
     pipeline.attach_trainer(trainer)
 
@@ -493,6 +522,10 @@ def main(argv=None) -> dict:
                 ),
             )
             watchdog.watch_fleet(router)
+            if sebulba:
+                # Both training lanes under the same supervision: a dead
+                # actor thread restarts, a wedged learner is surfaced.
+                trainer.attach_watchdog(watchdog)
             watchdog.start()
 
         # Chaos drill (chaos/, docs/chaos.md): arm a seeded fault
@@ -564,6 +597,19 @@ def main(argv=None) -> dict:
         if report_telemetry_url is not None:
             report["telemetry_url"] = report_telemetry_url
         report["pipeline_replicas"] = replicas
+        if sebulba:
+            # The transfer-plane health counters next to the promotion
+            # stats: one JSON line answers "did the split lanes keep up".
+            report["architecture"] = "sebulba"
+            report["transfer_queue_occupancy_p95"] = round(
+                trainer.occupancy_p95(), 2
+            )
+            report["param_staleness_p95_updates"] = round(
+                trainer.staleness_p95(), 2
+            )
+            report["sebulba_stale_dropped"] = trainer.stale_dropped
+            report["sebulba_actor_compiles"] = trainer.actor_guard.count
+            report["sebulba_learner_compiles"] = trainer.learner_guard.count
         report["fleet_swap_count"] = coordinator.swap_count
         if watchdog is not None:
             report["lane_restarts"] = watchdog.restarts_total()
@@ -628,6 +674,11 @@ def main(argv=None) -> dict:
             sampler_guard = getattr(trainer, "_sampler_guard", None)
             if sampler_guard is not None:
                 receipts += sampler_guard.count
+            if sebulba:
+                # The slice programs carry their own budget-1 guards
+                # (the Anakin guard above stays 0 — never dispatched).
+                receipts += trainer.actor_guard.count
+                receipts += trainer.learner_guard.count
             receipts += pipeline.gate.program.guard.count
             if pipeline.gate.adversary is not None:
                 receipts += pipeline.gate.adversary.guard.count

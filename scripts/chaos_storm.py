@@ -92,6 +92,18 @@ TRAIN_LANE_POINTS = (
     "train.grad_bomb",
     "train.snapshot",
 )
+# The --sebulba campaign's seams (train/sebulba/queues.py,
+# docs/sebulba.md): the three host transfer points between the actor
+# and learner slices. Each armed 'raise' is interpreted by its seam as
+# that seam's characteristic transport failure — enqueue DROPs the
+# batch (a seq gap), dequeue DUPLICATEs the delivery (the seq guard
+# must absorb it), param_publish holds the publish back (actors act on
+# STALE params until the next version lands).
+SEBULBA_POINTS = (
+    "sebulba.enqueue",
+    "sebulba.dequeue",
+    "sebulba.param_publish",
+)
 
 # Hit windows per point: high-frequency seams (polls, worker loops) can
 # absorb faults deep into the campaign; rare seams (one hit per commit
@@ -118,6 +130,13 @@ WINDOWS = {
     "train.carry_poison": 10,
     "train.grad_bomb": 10,
     "train.snapshot": 4,
+    # sebulba: enqueue/dequeue hit once per rollout, param_publish once
+    # per learner chunk (rollouts / K) — windows sized so every armed
+    # cell lands well inside a ~40-rollout campaign even after drops
+    # shrink the consumed stream.
+    "sebulba.enqueue": 10,
+    "sebulba.dequeue": 10,
+    "sebulba.param_publish": 6,
 }
 
 
@@ -745,6 +764,164 @@ def jax_device_get_params(trainer):
     return jax.device_get(trainer.train_state.params)
 
 
+def run_sebulba_campaign(
+    seed: int = 0,
+    faults: int = 10,
+    workdir: Optional[str] = None,
+    budget_s: float = 240.0,
+    num_agents: int = 3,
+    num_formations: int = 4,
+    train_iterations: int = 40,
+    fused_chunk: int = 2,
+    transfer_queue_depth: int = 2,
+    max_param_staleness: int = 2,
+) -> Dict[str, Any]:
+    """The storm pointed at the SEBULBA transfer seams (train/sebulba/,
+    docs/sebulba.md): a pipelined actor/learner run completes its whole
+    timestep budget while the seeded schedule drops trajectory batches
+    at the enqueue seam, redelivers them at the dequeue seam, and holds
+    params publishes back at the bus — then the lane's contracts are
+    checked over the run's host artifacts: no trajectory consumed
+    twice, params versions monotone at the consumer, staleness of every
+    CONSUMED batch bounded by ``max_param_staleness``, budget-1 compile
+    receipts per slice, crash-consistent checkpoint dir, finite final
+    params. One JSON line out."""
+    import tempfile
+
+    from marl_distributedformation_tpu.algo import PPOConfig
+    from marl_distributedformation_tpu.chaos import (
+        Violation,
+        check_bounded_staleness,
+        check_budget_one,
+        check_checkpoint_dir,
+        check_final_params_finite,
+        check_no_duplicate_consume,
+        check_params_version_monotone,
+        get_fault_plane,
+        report_violations,
+    )
+    from marl_distributedformation_tpu.env import EnvParams
+    from marl_distributedformation_tpu.train import (
+        SebulbaDriver,
+        TrainConfig,
+    )
+
+    t_start = time.perf_counter()
+    workdir = Path(
+        workdir
+        if workdir is not None
+        else tempfile.mkdtemp(prefix="chaos_sebulba_")
+    )
+    log_dir = workdir / "run"
+    env = EnvParams(num_agents=num_agents, max_steps=20)
+    schedule = build_schedule(seed, faults, point_names=SEBULBA_POINTS)
+    plane = get_fault_plane()
+    plane.reset()
+    report: Dict[str, Any] = {
+        "deterministic": {
+            "chaos_seed": int(seed),
+            "chaos_faults_armed": len(schedule),
+            "schedule": schedule.record(),
+        },
+    }
+    violations: List[Violation] = []
+
+    # One leg: the pipelined driver runs its whole budget (counted at
+    # the actor) under the armed transfer weather. Dropped batches slow
+    # the learner, never the budget; held-back publishes raise measured
+    # staleness, and the staleness gate must keep every batch that
+    # REACHES an update inside the bound.
+    per_iter = num_formations * num_agents * 5
+    driver = SebulbaDriver(
+        env,
+        ppo=PPOConfig(n_steps=5, n_epochs=2, batch_size=32),
+        config=TrainConfig(
+            num_formations=num_formations,
+            total_timesteps=train_iterations * per_iter,
+            save_freq=5,
+            fused_chunk=fused_chunk,
+            name="chaos_sebulba_storm",
+            log_dir=str(log_dir),
+            seed=0,
+            architecture="sebulba",
+            transfer_queue_depth=transfer_queue_depth,
+            max_param_staleness=max_param_staleness,
+        ),
+    )
+    plane.arm(schedule)
+    plane.enabled = True
+    try:
+        driver.train()  # must SURVIVE every transport failure
+    finally:
+        # Never leave the process-global plane live past the campaign.
+        plane.enabled = False
+
+    # ---- invariants ----------------------------------------------------
+    fired = plane.fired_record()
+    unfired = plane.pending()
+    queue = driver.transfer_queue
+    bus = driver.param_bus
+    violations += check_no_duplicate_consume(queue.consumed_seqs)
+    violations += check_params_version_monotone(driver.consumed_versions)
+    violations += check_bounded_staleness(
+        driver.consumed_staleness, max_param_staleness
+    )
+    violations += check_budget_one(
+        {
+            "sebulba_actor_rollout": driver.actor_guard.count,
+            "sebulba_learner_chunk": driver.learner_guard.count,
+        }
+    )
+    violations += check_checkpoint_dir(log_dir)
+    violations += check_final_params_finite(jax_device_get_params(driver))
+    dup_fired = [
+        f
+        for f in fired
+        if f["point"] == "sebulba.dequeue" and f["kind"] == "raise"
+    ]
+    if dup_fired and queue.duplicates_absorbed == 0:
+        violations.append(
+            Violation(
+                "no_duplicate_consume",
+                f"{len(dup_fired)} dequeue redelivery fault(s) fired but "
+                "the queue never absorbed a duplicate — the seq guard "
+                "was not exercised (the redelivery path is dead code "
+                "under this campaign)",
+            )
+        )
+    if unfired:
+        violations.append(
+            Violation(
+                "campaign_coverage",
+                f"{unfired} armed fault(s) never fired — the campaign "
+                "ended before exercising its whole schedule (raise "
+                "train_iterations or lower the hit windows)",
+            )
+        )
+    report["chaos_violations"] = report_violations(violations, plane)
+    report["chaos_invariant_violations"] = len(violations)
+    report["chaos_faults_fired"] = len(fired)
+    report["chaos_faults_unfired"] = unfired
+    report["sebulba_batches_enqueued"] = int(queue.enqueued_total)
+    report["sebulba_batches_dropped"] = int(queue.dropped_total)
+    report["sebulba_duplicates_absorbed"] = int(queue.duplicates_absorbed)
+    report["sebulba_publishes_dropped"] = int(bus.publishes_dropped)
+    report["sebulba_stale_dropped"] = int(driver.stale_dropped)
+    report["sebulba_batches_consumed"] = len(queue.consumed_seqs)
+    report["transfer_queue_occupancy_p95"] = round(
+        driver.occupancy_p95(), 2
+    )
+    report["param_staleness_p95_updates"] = round(
+        driver.staleness_p95(), 2
+    )
+    report["sebulba_actor_compiles"] = int(driver.actor_guard.count)
+    report["sebulba_learner_compiles"] = int(driver.learner_guard.count)
+    report["final_timesteps"] = int(driver.num_timesteps)
+    report["campaign_seconds"] = round(time.perf_counter() - t_start, 2)
+    del budget_s  # the pipelined run is bounded by its timestep budget
+    return report
+
+
 def run_mesh_campaign(
     seed: int = 0,
     faults: int = 20,
@@ -1073,14 +1250,62 @@ def main(argv: Optional[List[str]] = None) -> int:
         "MTTR, budget-1 receipts",
     )
     ap.add_argument(
+        "--sebulba",
+        action="store_true",
+        help="point the storm at the sebulba transfer seams "
+        "(train/sebulba): batch drops at enqueue, redeliveries at "
+        "dequeue, held-back params publishes at the bus, through a "
+        "live pipelined actor/learner run; invariants: no trajectory "
+        "consumed twice, params versions monotone, bounded staleness "
+        "on every consumed batch, budget-1 receipts per slice",
+    )
+    ap.add_argument(
         "--print-schedule",
         action="store_true",
         help="emit the armed fault schedule (deterministic from the "
         "seed) and exit without running anything",
     )
     args = ap.parse_args(argv)
-    if args.mesh and args.train:
-        ap.error("--mesh and --train are separate campaigns; pick one")
+    exclusive = [
+        name
+        for name, on in (
+            ("--mesh", args.mesh),
+            ("--train", args.train),
+            ("--sebulba", args.sebulba),
+        )
+        if on
+    ]
+    if len(exclusive) > 1:
+        ap.error(
+            f"{' and '.join(exclusive)} are separate campaigns; pick one"
+        )
+    if args.sebulba:
+        sebulba_faults = min(args.faults, 12)
+        if sebulba_faults < args.faults:
+            print(
+                f"[storm] --sebulba caps --faults at 12 (requested "
+                f"{args.faults}): the three transfer seams' armable "
+                "cells are bounded by the hit windows",
+                file=sys.stderr,
+            )
+        if args.print_schedule:
+            schedule = build_schedule(
+                args.seed, sebulba_faults, point_names=SEBULBA_POINTS
+            )
+            print(json.dumps({
+                "chaos_seed": args.seed,
+                "chaos_faults_armed": len(schedule),
+                "schedule": schedule.record(),
+            }))
+            return 0
+        report = run_sebulba_campaign(
+            seed=args.seed,
+            faults=sebulba_faults,
+            workdir=args.workdir,
+            budget_s=args.budget_s,
+        )
+        print(json.dumps(report))
+        return 0 if report.get("chaos_invariant_violations") == 0 else 1
     if args.train:
         train_faults = min(args.faults, 14)
         if train_faults < args.faults:

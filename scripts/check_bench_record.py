@@ -666,6 +666,95 @@ def _mesh_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _sebulba_problems(rec: dict) -> list[str]:
+    """Structural validation of the sebulba-lane fields (bench phase
+    17), whenever present: both throughput headlines finite positive
+    numbers; queue occupancy p95 a number in [0, depth] (> 0 would be
+    vacuous, but negative or non-numeric is malformed); staleness p95 a
+    finite non-negative number; BOTH per-slice compile receipts exactly
+    1 (the actor rollout and the learner chunk are one program each,
+    whatever the transfer weather did); and the gate's under-load eval
+    p50 a finite positive number whenever recorded beside them.
+    ``"skipped"`` sentinels are honored as structurally absent."""
+    problems = []
+    for key in (
+        "sebulba_env_steps_per_sec",
+        "sebulba_learner_steps_per_sec",
+    ):
+        v = _present(rec, key)
+        if v is None:
+            continue
+        try:
+            f = float(v)
+            if not math.isfinite(f) or f <= 0.0:
+                problems.append(
+                    f"{key}={v!r} (need a finite number > 0 — a zero "
+                    "rate means that slice never ran)"
+                )
+        except (TypeError, ValueError):
+            problems.append(f"{key} is not a number: {v!r}")
+    occupancy = _present(rec, "transfer_queue_occupancy_p95")
+    if occupancy is not None:
+        try:
+            f = float(occupancy)
+            if not math.isfinite(f) or f < 0.0:
+                problems.append(
+                    f"transfer_queue_occupancy_p95={occupancy!r} "
+                    "(need a finite number >= 0)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                "transfer_queue_occupancy_p95 is not a number: "
+                f"{occupancy!r}"
+            )
+    staleness = _present(rec, "param_staleness_p95_updates")
+    if staleness is not None:
+        try:
+            f = float(staleness)
+            if not math.isfinite(f) or f < 0.0:
+                problems.append(
+                    f"param_staleness_p95_updates={staleness!r} "
+                    "(need a finite number >= 0)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                "param_staleness_p95_updates is not a number: "
+                f"{staleness!r}"
+            )
+    for key in ("sebulba_actor_compiles", "sebulba_learner_compiles"):
+        receipts = _present(rec, key)
+        if receipts is None:
+            continue
+        if receipts != 1:
+            problems.append(
+                f"{key}={receipts!r} — each slice's program must "
+                "compile exactly once across the whole pipelined run "
+                "(the per-slice budget-1 receipt)"
+            )
+    gate_p50 = _present(rec, "gate_eval_p50_under_load_s")
+    if gate_p50 is not None:
+        try:
+            f = float(gate_p50)
+            if not math.isfinite(f) or f <= 0.0:
+                problems.append(
+                    f"gate_eval_p50_under_load_s={gate_p50!r} (need a "
+                    "finite number > 0: the gate evaluates a real "
+                    "candidate while the learner is saturated)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"gate_eval_p50_under_load_s is not a number: {gate_p50!r}"
+            )
+        gate_compiles = _present(rec, "sebulba_gate_compiles")
+        if gate_compiles is not None and gate_compiles != 1:
+            problems.append(
+                f"sebulba_gate_compiles={gate_compiles!r} — the gate's "
+                "matrix program on its own slice must compile exactly "
+                "once across the warm eval and every under-load eval"
+            )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -688,6 +777,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_ledger_problems(rec))
     problems.extend(_mesh_problems(rec))
     problems.extend(_lint_problems(rec))
+    problems.extend(_sebulba_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(

@@ -1585,6 +1585,69 @@ FIXTURES = [
                     self.step += 1
         """,
     ),
+    (
+        # blocking-transfer-in-actor-loop: a device_get + a method-
+        # spelled block_until_ready inside the actor lane's while loop —
+        # one sync per rollout on the acting critical path. The good
+        # twin hands the device tree to the transfer-queue seam (method
+        # calls are deliberately not followed: the queue's enqueue-time
+        # device_put is the sanctioned off-critical-path home) and the
+        # same calls OUTSIDE an actor/transfer scope stay clean.
+        "blocking-transfer-in-actor-loop",
+        """
+        import jax
+
+        def actor_loop(program, queue, bus, stop):
+            state = None
+            while not stop.is_set():
+                version, params = bus.latest()
+                state, batch = program(params, state)
+                batch.block_until_ready()  # actor idles out the device
+                queue.put(jax.device_get(batch), version)  # host round trip
+        """,
+        """
+        import jax
+
+        def actor_loop(program, queue, bus, stop):
+            state = None
+            while not stop.is_set():
+                version, params = bus.latest()
+                state, batch = program(params, state)
+                queue.put(batch, version)  # device tree; the queue places it
+
+        def drain(chunks):
+            stacks = [c for c in chunks]
+            return jax.device_get(stacks)  # learner-side amortized drain
+        """,
+    ),
+    (
+        # Same hazard one local hop deep: the transfer worker's for-loop
+        # calls a same-module helper that device_puts per item. The good
+        # twin keeps an IDENTICAL loop+helper under a name outside the
+        # actor/transfer convention (the learner's drain loop) — the
+        # rule is scoped to acting/transfer lanes, not to every loop.
+        "blocking-transfer-in-actor-loop",
+        """
+        import jax
+
+        def _place(item, device):
+            return jax.device_put(item, device)
+
+        def transfer_worker(items, device, out):
+            for item in items:
+                out.append(_place(item, device))  # upload per item
+        """,
+        """
+        import jax
+
+        def _place(item, device):
+            return jax.device_put(item, device)
+
+        def learner_drain(items, device, out):
+            for item in items:
+                out.append(_place(item, device))
+        """,
+    ),
 ]
 
 

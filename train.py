@@ -91,6 +91,12 @@ def train_config_from_config(cfg) -> TrainConfig:
             cfg.get("recovery_severity_backoff", 1.0)
         ),
         keep_last_n=int(cfg.get("keep_last_n", 0)),
+        # Sebulba lane (train/sebulba/, docs/sebulba.md): split
+        # acting/learning with hardened host-side transfer queues.
+        architecture=str(cfg.get("architecture", "anakin")),
+        actor_devices=int(cfg.get("actor_devices", 1)),
+        transfer_queue_depth=int(cfg.get("transfer_queue_depth", 2)),
+        max_param_staleness=int(cfg.get("max_param_staleness", 2)),
     )
 
 
@@ -185,6 +191,12 @@ def build_trainer(cfg) -> Trainer:
     # Fail-fast at config time: unknown scenario names raise here naming
     # the registry entries (never a silent clean-env run).
     scenario_schedule = scenario_schedule_from_config(cfg)
+    if train_cfg.architecture == "sebulba" and cfg.get("curriculum"):
+        raise SystemExit(
+            "architecture=sebulba does not compose with curriculum "
+            "training yet (the hetero stage machinery is Anakin-shaped); "
+            "drop one of the two"
+        )
     if cfg.get("curriculum"):
         if num_seeds > 1 and learning_rates:
             raise SystemExit(
@@ -203,6 +215,31 @@ def build_trainer(cfg) -> Trainer:
         )
     policy = cfg.get("policy", "mlp")
     model = build_model(cfg, env_params, policy)
+    if train_cfg.architecture == "sebulba":
+        if num_seeds > 1:
+            raise SystemExit(
+                "architecture=sebulba does not compose with num_seeds>1 "
+                "population sweeps yet (the sweep's vmapped iteration is "
+                "Anakin-shaped); drop one of the two"
+            )
+        from marl_distributedformation_tpu.train import SebulbaDriver
+
+        # Mesh / curriculum / recovery incompatibilities fail fast inside
+        # the driver with actionable messages.
+        return SebulbaDriver(
+            env_params,
+            ppo=ppo,
+            config=train_cfg,
+            model=model,
+            shard_fn=shard_fn,
+            scenario_schedule=scenario_schedule,
+        )
+    if train_cfg.architecture != "anakin":
+        raise SystemExit(
+            f"architecture={train_cfg.architecture!r} is unknown; "
+            "available: anakin (fused same-device), sebulba (split "
+            "acting/learning — docs/sebulba.md)"
+        )
     if num_seeds > 1:
         if scenario_schedule is not None:
             raise SystemExit(
