@@ -22,6 +22,12 @@ Measures, inside one process and one JSON line:
 - ``scenario_env_steps_per_sec``: env stepping through the 3-layer
   "storm" disturbance stack (scenarios/) — the scenario engine's wrapper
   overhead vs the clean headline (``scenario_overhead_pct``).
+- ``env_steps_per_sec_formation`` / ``env_steps_per_sec_pursuit_evasion``:
+  the registered-env ladder (envs/) — every env in the registry timed
+  through the same random-policy chunk via params-type dispatch, plus
+  ``obstacle_overhead_pct``: the obstacle_field occlusion layer
+  (layout-driven neighbor masking) vs the clean step on the same
+  4-obstacle params.
 - ``train_env_steps_per_sec_fused_scan``: the Anakin fused-scan trainer
   (``TrainConfig.fused_chunk``): K full PPO iterations per ``lax.scan``
   dispatch, best rate over the chunk ladder {1, 8, 32}, with the
@@ -133,7 +139,8 @@ BENCH_SWEEP_CHUNKS (default "1,8"; empty disables the fused-sweep
 rungs), BENCH_SWEEP_SEEDS, BENCH_SWEEP_M, BENCH_SWEEP_REPEATS
 (interleaved best-of passes per rung, default 5), BENCH_SKIP_SWEEP=1,
 BENCH_FORCE_CPU=1, BENCH_SKIP_TRAIN=1, BENCH_SKIP_KNN=1,
-BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1, BENCH_SKIP_SERVING=1,
+BENCH_SKIP_KNN_BIG=1, BENCH_SKIP_SCENARIO=1, BENCH_SKIP_ENVS=1,
+BENCH_ENVS_M, BENCH_SKIP_SERVING=1,
 BENCH_SERVING_DURATION_S, BENCH_SKIP_PIPELINE=1, BENCH_PIPELINE_M,
 BENCH_PIPELINE_GATE_M, BENCH_PIPELINE_BUDGET_S, BENCH_SLO_DURATION_S,
 BENCH_SLO_P95_MS, BENCH_SKIP_ADVERSARIAL=1, BENCH_ADV_M,
@@ -243,7 +250,12 @@ def make_runner(params, m: int, chunk: int):
     formations per call (amortizes dispatch/tunnel RTT)."""
     import jax
 
-    from marl_distributedformation_tpu.env.formation import step_batch
+    from marl_distributedformation_tpu.envs import spec_for_params
+
+    # Registered-env dispatch (envs/): formation params resolve to the
+    # legacy step_batch verbatim, PursuitParams to the pursuit step — the
+    # same runner times every registered env.
+    step_batch = spec_for_params(params).step_batch
 
     @jax.jit
     def run_chunk(state, key):
@@ -308,9 +320,9 @@ def _time_env_phase(
     ``scenario`` (ScenarioParams) times the disturbance-stacked step."""
     import jax
 
-    from marl_distributedformation_tpu.env.formation import reset_batch
+    from marl_distributedformation_tpu.envs import spec_for_params
 
-    state = reset_batch(jax.random.PRNGKey(0), params, m)
+    state = spec_for_params(params).reset_batch(jax.random.PRNGKey(0), params, m)
     if scenario is None:
         run_chunk = make_runner(params, m, chunk)
     else:
@@ -769,6 +781,85 @@ def main() -> None:
                 )
             except Exception as e:  # noqa: BLE001 — degrade, don't die
                 notes.append(f"scenario phase failed: {e!r}"[:200])
+
+        # Phase 1d — registered-env ladder (envs/, docs/environments.md):
+        # the SAME random-policy chunk through every registered
+        # environment at matched M/N/chunk, via the registry's params-type
+        # dispatch (spec_for_params) — the formation rate here re-times
+        # the headline path through the registry (a materially lower
+        # number than phase 1 would mean the indirection itself costs,
+        # which it must not: the dispatch resolves at trace time), and
+        # the pursuit rate is the second env's first perf number. Plus
+        # obstacle_overhead_pct: the obstacle_field occlusion layer
+        # (layout-driven neighbor masking, scenarios/layers.py) vs the
+        # clean step on the SAME num_obstacles>0 params.
+        if os.environ.get("BENCH_SKIP_ENVS") == "1":
+            _mark_skipped(
+                result,
+                "envs",
+                (
+                    "env_steps_per_sec_formation",
+                    "env_steps_per_sec_pursuit_evasion",
+                    "obstacle_overhead_pct",
+                ),
+            )
+        elif time.time() < deadline - 30:
+            try:
+                from marl_distributedformation_tpu.envs import (
+                    get_env,
+                    registered_envs,
+                )
+                from marl_distributedformation_tpu.scenarios import (
+                    broadcast_params,
+                    scenario_params_for,
+                )
+
+                envs_m = _env_int("BENCH_ENVS_M", M if on_accel else 256)
+                envs_chunk = max(CHUNK // 8, 16)
+                for env_name in registered_envs():
+                    spec = get_env(env_name)
+                    env_rate = _time_env_phase(
+                        spec.default_params(num_agents=N),
+                        envs_m, envs_chunk, deadline,
+                    )
+                    result[f"env_steps_per_sec_{env_name}"] = round(
+                        env_rate, 1
+                    )
+                    print(
+                        f"[bench] envs ({env_name}): {env_rate:,.0f} "
+                        "formation-steps/s",
+                        file=sys.stderr,
+                    )
+                result["envs_m"] = envs_m
+                # Obstacle-layer overhead: clean vs obstacle_field@1.0
+                # (80 px occlusion masking the layout-declared neighbor
+                # blocks) on the same 4-obstacle formation params.
+                obst_params = EnvParams(num_agents=N, num_obstacles=4)
+                clean_rate = _time_env_phase(
+                    obst_params, envs_m, envs_chunk, deadline
+                )
+                occl = broadcast_params(
+                    scenario_params_for("obstacle_field", 1.0), envs_m
+                )
+                occl_rate = _time_env_phase(
+                    obst_params, envs_m, envs_chunk, deadline, scenario=occl
+                )
+                if clean_rate:
+                    result["obstacle_overhead_pct"] = round(
+                        max(0.0, (1.0 - occl_rate / clean_rate) * 100.0), 1
+                    )
+                result["obstacle_stack"] = "obstacle_field@1.0 (K=4)"
+                print(
+                    f"[bench] obstacle_field occlusion: {occl_rate:,.0f} "
+                    f"vs clean {clean_rate:,.0f} formation-steps/s "
+                    f"({result.get('obstacle_overhead_pct', 0.0):.1f}% "
+                    "overhead)",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                notes.append(f"envs phase failed: {e!r}"[:200])
+        else:
+            notes.append("envs phase skipped: deadline")
 
         # Phase 2 — full PPO training iteration, at BOTH hyperparameter
         # points: the reference-parity config (SB3 batch_size=64 — tiny

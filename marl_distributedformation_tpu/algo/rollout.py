@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from marl_distributedformation_tpu.env import EnvParams, FormationState
-from marl_distributedformation_tpu.env.formation import step_batch
+from marl_distributedformation_tpu.envs import spec_for_params
 from marl_distributedformation_tpu.models import distributions
 
 Array = jax.Array
@@ -57,8 +57,11 @@ def collect_rollout(
     reference's adapter does it (vectorized_env.py:69-70).
 
     ``env_step_fn(state, velocity) -> (state, transition)`` defaults to the
-    vmapped single-chip step; pass a ring step (``parallel.make_ring_step``)
-    to roll with the agent axis sharded over 'sp'.
+    REGISTERED env's vmapped single-chip step, resolved from the params type
+    (``envs.spec_for_params`` — formation params resolve to the legacy
+    ``step_batch`` verbatim, so that path is bitwise unchanged); pass a ring
+    step (``parallel.make_ring_step``) to roll with the agent axis sharded
+    over 'sp'.
 
     ``mask`` is an optional ``(M, N)`` agent-validity mask forwarded to
     per-formation models (CTDE/GNN) for padded heterogeneous batches; it is
@@ -68,8 +71,10 @@ def collect_rollout(
     Returns ``(env_state, last_obs, batch, last_value)``.
     """
     if env_step_fn is None:
+        env_spec = spec_for_params(env_params)
+
         def env_step_fn(state, velocity):
-            return step_batch(state, velocity, env_params)
+            return env_spec.step_batch(state, velocity, env_params)
 
     def policy(obs):
         if mask is not None:

@@ -25,6 +25,9 @@ from marl_distributedformation_tpu.analysis.rules.dispatch_transfer import (
     DevicePutInDispatchLoop,
 )
 from marl_distributedformation_tpu.analysis.rules.donation import MissingDonate
+from marl_distributedformation_tpu.analysis.rules.env_contract import (
+    EnvContractImpurity,
+)
 from marl_distributedformation_tpu.analysis.rules.f64_promotion import (
     ImplicitF64Promotion,
 )
@@ -97,6 +100,7 @@ RULES = (
     BlockingCallUnderDispatchLock(),
     LockReleasedAcrossAwaitSeam(),
     BlockingTransferInActorLoop(),
+    EnvContractImpurity(),
 )
 
 

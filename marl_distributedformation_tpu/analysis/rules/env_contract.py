@@ -1,0 +1,101 @@
+"""env-contract-impurity: host impurity inside an env step/reset.
+
+The ``envs/`` contract (docs/environments.md) requires ``reset`` /
+``step`` / ``reset_batch`` / ``step_batch`` to be pure pytree->pytree
+functions: all randomness flows through the explicit JAX key threaded in
+the state, and nothing closes over mutable trace-time host state. An env
+that draws from the HOST RNG (``np.random.*`` / stdlib ``random.*``)
+traces the draw ONCE and bakes the sample into the compiled program —
+every subsequent call replays the same "random" value, which trains and
+evals without error on silently degenerate data. A ``global`` statement
+in a step is the same bug from the other side: the rebind happens at
+trace time only, so the compiled steps disagree with the host's idea of
+the state.
+
+Detection is name-scoped: functions named exactly ``step`` / ``reset`` /
+``step_batch`` / ``reset_batch`` (the registered-contract field names,
+``envs/spec.py``) and every function nested inside one. Host RNG aliases
+are resolved from the module's imports, so ``from jax import random``
+never collides with stdlib ``random``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set, Tuple
+
+from marl_distributedformation_tpu.analysis.linter import (
+    FunctionLike,
+    ModuleContext,
+    Rule,
+    dotted_name,
+)
+
+# The registered-env contract surface (EnvSpec field names, envs/spec.py).
+_ENV_FN_NAMES = frozenset({"step", "reset", "step_batch", "reset_batch"})
+
+
+def _host_rng_aliases(tree: ast.Module) -> Set[str]:
+    """Dotted prefixes denoting the HOST RNG in this module: stdlib
+    ``random`` and ``numpy.random`` under whatever names they were
+    imported as. Keyed on actual imports, so ``from jax import random``
+    (the JAX module) is never mistaken for the stdlib."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "random":
+                    aliases.add(a.asname or "random")
+                elif a.name == "numpy":
+                    aliases.add(f"{a.asname or 'numpy'}.random")
+                elif a.name == "numpy.random":
+                    aliases.add(a.asname or "numpy.random")
+        elif isinstance(node, ast.ImportFrom) and node.module == "numpy":
+            for a in node.names:
+                if a.name == "random":
+                    aliases.add(a.asname or "random")
+    return aliases
+
+
+class EnvContractImpurity(Rule):
+    name = "env-contract-impurity"
+    default_severity = "error"
+    description = (
+        "an env step/reset draws from the host RNG or rebinds a global — "
+        "the draw is baked in at trace time; thread a JAX key instead"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        aliases = _host_rng_aliases(ctx.tree)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name not in _ENV_FN_NAMES:
+                continue
+            # The whole subtree: closures (scan bodies, vmapped helpers)
+            # trace with the env function they are defined in.
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        f"env function {fn.name!r} rebinds global(s) "
+                        f"{', '.join(node.names)} — mutable host state "
+                        "does not survive tracing; carry it in the env "
+                        "state pytree",
+                    )
+                elif isinstance(node, ast.Call):
+                    fname = dotted_name(node.func) or ""
+                    head = fname.rpartition(".")[0]
+                    if head in aliases:
+                        yield (
+                            node.lineno,
+                            node.col_offset,
+                            f"env function {fn.name!r} calls host RNG "
+                            f"{fname}() — the sample is baked into the "
+                            "compiled step; use jax.random with the "
+                            "key threaded through the state",
+                        )
+
+
+__all__ = ["EnvContractImpurity"]

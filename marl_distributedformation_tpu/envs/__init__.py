@@ -1,0 +1,42 @@
+"""Typed environment subsystem: one contract, many pure-JAX envs.
+
+The JaxMARL / Jumanji idiom (docs/environments.md): every environment is
+a set of pure functions behind one ``EnvSpec`` contract with declared
+observation-layout metadata, named in a fail-fast registry. Downstream
+code resolves the spec from the env params it already holds
+(``spec_for_params``), so the trainer, scenario engine, promotion gate,
+and serving ladder are env-generic with zero signature churn — and the
+formation env resolves to the legacy ``env/formation.py`` functions
+verbatim (bitwise-identical trajectories, pinned in tests/test_envs.py).
+
+    from marl_distributedformation_tpu import envs
+
+    spec = envs.get("formation")           # fail-fast, did-you-mean
+    spec = envs.spec_for_params(params)    # dispatch on params type
+    state, obs = spec.reset_env(key, spec.default_params())
+"""
+
+from marl_distributedformation_tpu.envs.spec import (  # noqa: F401
+    EnvSpec,
+    ObsLayout,
+)
+from marl_distributedformation_tpu.envs.registry import (  # noqa: F401
+    get_env,
+    register_env,
+    registered_envs,
+    spec_for_params,
+)
+from marl_distributedformation_tpu.envs.formation import (  # noqa: F401
+    FORMATION_SPEC,
+    formation_obs_layout,
+)
+from marl_distributedformation_tpu.envs.pursuit import (  # noqa: F401
+    PURSUIT_SPEC,
+    PursuitParams,
+)
+
+# ``envs.get("formation")`` — the registry's canonical spelling.
+get = get_env
+
+register_env(FORMATION_SPEC)
+register_env(PURSUIT_SPEC)

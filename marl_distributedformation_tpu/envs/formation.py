@@ -1,0 +1,70 @@
+"""The formation env behind the contract — the legacy functions, verbatim.
+
+This module creates NO new step/reset code: the spec's fields ARE the
+``env/formation.py`` functions (asserted identical in tests/test_envs.py),
+so resolving formation through the registry is bitwise identical to the
+legacy direct-import path by construction. The only new code is the
+declared observation layout, which makes explicit what ``compute_obs`` /
+``_assemble_knn_obs`` lay out implicitly (and what
+``scenarios/layers.py`` used to hard-code).
+"""
+
+from __future__ import annotations
+
+from marl_distributedformation_tpu.env.formation import (
+    compute_obs,
+    reset,
+    reset_batch,
+    step,
+    step_batch,
+)
+from marl_distributedformation_tpu.env.types import EnvParams
+from marl_distributedformation_tpu.envs.spec import EnvSpec, ObsLayout
+
+
+def formation_obs(state, params: EnvParams):
+    """Recompute observations from a (possibly batched) state."""
+    return compute_obs(state.agents, state.goal, params)
+
+
+def formation_obs_layout(params: EnvParams) -> ObsLayout:
+    """The layout ``compute_obs`` (ring) / ``_assemble_knn_obs`` (knn)
+    produce, as declared block metadata.
+
+    ring: ``[self (2) | neighbor: prev+next offsets (4) | goal (2)?]``.
+    knn:  ``[self (2) | neighbor: offsets (2k) + dists (k) | goal (2)? |
+    neighbor: indices (k)]`` — the neighbor block is two disjoint ranges.
+    """
+    dim = params.obs_dim
+    if params.obs_mode == "knn":
+        k = params.knn_k
+        blocks = [
+            ("self", ((0, 2),)),
+            ("neighbor", ((2, 2 + 3 * k), (dim - k, dim))),
+        ]
+        if params.goal_in_obs:
+            blocks.append(("goal", ((2 + 3 * k, 2 + 3 * k + 2),)))
+    else:
+        blocks = [("self", ((0, 2),)), ("neighbor", ((2, 6),))]
+        if params.goal_in_obs:
+            blocks.append(("goal", ((6, 8),)))
+    return ObsLayout(
+        dim=dim, topology=params.obs_mode, blocks=tuple(blocks)
+    )
+
+
+FORMATION_SPEC = EnvSpec(
+    name="formation",
+    description=(
+        "ring-formation control (the reference env): N agents form a "
+        "regular polygon around a static goal — env/formation.py, "
+        "reference simulate.py"
+    ),
+    params_cls=EnvParams,
+    reset=reset,
+    step=step,
+    obs=formation_obs,
+    reset_batch=reset_batch,
+    step_batch=step_batch,
+    obs_layout=formation_obs_layout,
+)

@@ -1,0 +1,267 @@
+"""Pursuit-evasion: the second pure-JAX environment behind the contract.
+
+N evaders (the learning agents) flee ONE scripted pursuer while holding
+ring cohesion. The design deliberately reuses the formation env's
+machinery nearly unchanged (ROADMAP item 3c: "pursuit-evasion ...
+reuse the formation obs/knn structure almost unchanged"):
+
+- **State** is ``FormationState`` with ``goal`` reinterpreted as the
+  pursuer's position — so resets, auto-reset ``tree_select``, the PRNG
+  stream discipline, and every pytree-shaped downstream program (fused
+  scan, sebulba queues, checkpoints) work structurally unchanged.
+- **Observations** are ``compute_obs`` verbatim: the relative-"goal"
+  block becomes the relative-pursuer block (declared as ``pursuer`` in
+  the obs layout — a layer that needs a ``goal`` block fails fast here
+  instead of silently masking pursuer columns). ``obs_mode="knn"`` and
+  the Pallas neighbor search work as-is.
+- **Physics, metrics, episode accounting** are the formation functions
+  (``integrate``, ``_in_obstacle``, ``compute_metrics``, the Q1 parity
+  done rule), so ``eval.episode_length`` and the metric keys the gate,
+  sweeps, and bench consume (``avg_dist_to_goal`` = distance to the
+  pursuer here, ``ave_dist_to_neighbor``) hold for both envs.
+
+The pursuer is scripted pure-JAX: each step it moves ``pursuer_speed``
+toward the nearest evader (no overshoot), clipped to the world box. The
+reward flips the goal-shaping sign — evaders are paid to be FAR from the
+pursuer, penalized hard within ``capture_radius`` — and keeps the
+neighbor-spacing / out-of-bounds / obstacle terms and ring reward mixing,
+so the task is "flee together in formation", not "scatter".
+
+Scenario layers compose unchanged (scenarios/ resolves step/obs through
+the registry): ``moving_goal`` drifts the pursuer, ``comm_dropout`` and
+the obstacle layers read this env's declared layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from marl_distributedformation_tpu.env.formation import (
+    _in_obstacle,
+    compute_metrics,
+    compute_obs,
+    integrate,
+    reset,
+    ring_neighbors,
+)
+from marl_distributedformation_tpu.env.types import (
+    EnvParams,
+    FormationState,
+    Transition,
+    tree_select,
+)
+from marl_distributedformation_tpu.envs.spec import EnvSpec, ObsLayout
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class PursuitParams(EnvParams):
+    """Formation params + the pursuit knobs.
+
+    Subclassing ``EnvParams`` (rather than a fresh dataclass) is what
+    makes the whole stack env-generic for free: every call site that
+    threads ``EnvParams`` duck-types these, and ``envs.spec_for_params``
+    dispatches on the most-derived registered type.
+    """
+
+    pursuer_speed: float = 7.0  # px/step, < max_speed so evasion is possible
+    capture_radius: float = 30.0  # px — within this the evader is "caught"
+    capture_penalty: float = 50.0  # per-step penalty while caught
+    evade_reward_scale: float = 0.05  # reward per px of pursuer distance
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        assert self.pursuer_speed >= 0.0
+        assert self.capture_radius >= 0.0
+
+
+def pursuer_update(
+    agents: Array, pursuer: Array, params: PursuitParams
+) -> Array:
+    """Scripted pursuer policy: move ``pursuer_speed`` toward the nearest
+    evader (no overshoot), clipped to the world box. Pure JAX — argmin +
+    normalized direction, no host branching."""
+    dists = jnp.linalg.norm(agents - pursuer[None, :], axis=-1)
+    nearest = agents[jnp.argmin(dists)]
+    delta = nearest - pursuer
+    gap = jnp.linalg.norm(delta)
+    direction = delta / jnp.maximum(gap, 1e-6)
+    moved = pursuer + jnp.minimum(params.pursuer_speed, gap) * direction
+    wh = jnp.array([params.width, params.height], jnp.float32)
+    return jnp.clip(moved, 0.0, wh)
+
+
+def pursuit_reward(
+    agents: Array,
+    pursuer: Array,
+    out_of_bounds: Array,
+    in_obstacle: Array,
+    params: PursuitParams,
+):
+    """Per-agent evade reward with the formation env's cohesion terms.
+
+    Mirrors ``compute_reward``'s structure: individual terms, then the
+    ring reward mixing ``(1-2p)*r_i + p*(r_prev + r_next)`` — fleeing is
+    a team sport here, exactly like formation-holding.
+    """
+    dist_to_pursuer = jnp.linalg.norm(agents - pursuer[..., None, :], axis=-1)
+    evade_reward = params.evade_reward_scale * dist_to_pursuer
+    caught = dist_to_pursuer < params.capture_radius
+    capture_penalty = -params.capture_penalty * caught
+
+    # Asymmetric neighbor-spacing penalty, verbatim formation semantics:
+    # quadratic when too close, linear when too far.
+    prev_pos, next_pos = ring_neighbors(agents, -2)
+    target = params.desired_neighbor_dist
+    right_diff = jnp.linalg.norm(agents - next_pos, axis=-1) - target
+    left_diff = jnp.linalg.norm(agents - prev_pos, axis=-1) - target
+    reward_right = -params.neighbor_penalty_scale * jnp.where(
+        right_diff < 0, right_diff**2, right_diff
+    )
+    reward_left = -params.neighbor_penalty_scale * jnp.where(
+        left_diff < 0, left_diff**2, left_diff
+    )
+
+    individual = (
+        evade_reward
+        + capture_penalty
+        + reward_right
+        + reward_left
+        - params.oob_penalty * out_of_bounds
+        - params.obstacle_penalty * in_obstacle
+    )
+
+    rho = params.share_reward_ratio
+    prev_r, next_r = ring_neighbors(individual, -1)
+    mixed = (1.0 - 2.0 * rho) * individual + rho * (prev_r + next_r)
+
+    terms = {
+        "evade_reward": evade_reward,
+        "capture_penalty": capture_penalty,
+        "reward_right_neighbor": reward_right,
+        "reward_left_neighbor": reward_left,
+    }
+    return mixed, terms
+
+
+def pursuit_step(
+    state: FormationState,
+    velocity: Array,
+    params: PursuitParams,
+    with_obs: bool = True,
+) -> Tuple[FormationState, Transition]:
+    """One formation of evaders, one step (contract: envs/spec.py).
+
+    Same skeleton and ordering as ``formation.step``: integrate → flag
+    bounds/obstacles → scripted pursuer moves (reacting to the evaders'
+    NEW positions) → reward on the pre-reset state → parity done rule →
+    auto-reset → obs/metrics on the (possibly reset) state.
+    """
+    agents, out_of_bounds = integrate(state.agents, velocity, params)
+    in_obstacle = _in_obstacle(agents, state.obstacles, params)
+    pursuer = pursuer_update(agents, state.goal, params)
+
+    reward, reward_terms = pursuit_reward(
+        agents, pursuer, out_of_bounds, in_obstacle, params
+    )
+
+    if params.strict_parity:
+        done = state.steps > params.max_steps
+    else:
+        done = state.steps + 1 >= params.max_steps
+
+    stepped = FormationState(
+        agents=agents,
+        goal=pursuer,
+        obstacles=state.obstacles,
+        steps=state.steps + 1,
+        key=state.key,
+    )
+    fresh = reset(state.key, params)
+    next_state = tree_select(done, fresh, stepped)
+
+    if with_obs:
+        obs = compute_obs(next_state.agents, next_state.goal, params)
+    else:
+        obs = jnp.zeros((state.agents.shape[-2], 0), jnp.float32)
+    metrics = compute_metrics(next_state.agents, next_state.goal, params)
+    metrics.update({k: v.mean() for k, v in reward_terms.items()})
+    metrics["reward"] = reward.mean()
+
+    return next_state, Transition(
+        obs=obs, reward=reward, done=done, metrics=metrics
+    )
+
+
+def pursuit_reset_batch(
+    key: Array, params: PursuitParams, num_formations: int
+) -> FormationState:
+    keys = jax.random.split(key, num_formations)
+    return jax.vmap(reset, in_axes=(0, None))(keys, params)
+
+
+def pursuit_step_batch(
+    state: FormationState, velocity: Array, params: PursuitParams
+) -> Tuple[FormationState, Transition]:
+    """Batched pursuit step, mirroring ``formation.step_batch``'s knn
+    routing (the batched neighbor search sees ``(M, N, 2)`` at once)."""
+    if params.obs_mode == "knn":
+        next_state, tr = jax.vmap(
+            functools.partial(pursuit_step, with_obs=False),
+            in_axes=(0, 0, None),
+        )(state, velocity, params)
+        obs = compute_obs(next_state.agents, next_state.goal, params)
+        return next_state, tr.replace(obs=obs)
+    return jax.vmap(pursuit_step, in_axes=(0, 0, None))(
+        state, velocity, params
+    )
+
+
+def pursuit_obs(state: FormationState, params: PursuitParams) -> Array:
+    return compute_obs(state.agents, state.goal, params)
+
+
+def pursuit_obs_layout(params: PursuitParams) -> ObsLayout:
+    """Formation's column geometry with the relative-goal block renamed
+    ``pursuer`` — layers needing a ``goal`` block fail fast here rather
+    than silently masking pursuer columns (spec.ObsLayout.require)."""
+    dim = params.obs_dim
+    if params.obs_mode == "knn":
+        k = params.knn_k
+        blocks = [
+            ("self", ((0, 2),)),
+            ("neighbor", ((2, 2 + 3 * k), (dim - k, dim))),
+        ]
+        if params.goal_in_obs:
+            blocks.append(("pursuer", ((2 + 3 * k, 2 + 3 * k + 2),)))
+    else:
+        blocks = [("self", ((0, 2),)), ("neighbor", ((2, 6),))]
+        if params.goal_in_obs:
+            blocks.append(("pursuer", ((6, 8),)))
+    return ObsLayout(
+        dim=dim, topology=params.obs_mode, blocks=tuple(blocks)
+    )
+
+
+PURSUIT_SPEC = EnvSpec(
+    name="pursuit_evasion",
+    description=(
+        "pursuit-evasion: N evaders flee one scripted pursuer (moves "
+        "pursuer_speed toward the nearest evader each step) while "
+        "holding ring cohesion — formation machinery reused, goal slot "
+        "carries the pursuer"
+    ),
+    params_cls=PursuitParams,
+    reset=reset,
+    step=pursuit_step,
+    obs=pursuit_obs,
+    reset_batch=pursuit_reset_batch,
+    step_batch=pursuit_step_batch,
+    obs_layout=pursuit_obs_layout,
+)

@@ -1,0 +1,94 @@
+"""EnvSpec registry: named environments + params-type dispatch.
+
+Mirrors the scenario registry's discipline (scenarios/registry.py): every
+lookup fails fast on unknown names with a did-you-mean and the full
+registry listing — a typo must never silently train/evaluate the default
+environment.
+
+The second lookup axis is the important one: ``spec_for_params(params)``
+resolves the spec from the *type* of an ``EnvParams`` pytree. Downstream
+code (eval.py, scenarios/engine.py, train/trainer.py, the gate's matrix
+program) already threads env params everywhere, so dispatching on the
+params type makes the whole stack env-generic with ZERO signature churn —
+and the formation env resolves to the very same ``env/formation.py``
+functions it always called, keeping that path bitwise identical.
+"""
+
+from __future__ import annotations
+
+import difflib
+from typing import Dict, Tuple
+
+from marl_distributedformation_tpu.envs.spec import EnvSpec
+
+_REGISTRY: Dict[str, EnvSpec] = {}
+_BY_PARAMS_CLS: Dict[type, EnvSpec] = {}
+
+
+def registered_envs() -> Tuple[str, ...]:
+    """Registered environment names, registration order."""
+    return tuple(_REGISTRY)
+
+
+def register_env(spec: EnvSpec, overwrite: bool = False) -> None:
+    """Add an environment (how-to: docs/environments.md).
+
+    Overwriting a name is opt-in, and each env must bring its own
+    ``params_cls`` — two envs sharing one params type would make
+    ``spec_for_params`` ambiguous (subclass the params instead, as
+    ``PursuitParams(EnvParams)`` does).
+    """
+    if spec.name in _REGISTRY and not overwrite:
+        raise ValueError(
+            f"environment {spec.name!r} is already registered; pass "
+            "overwrite=True to replace it"
+        )
+    claimed = _BY_PARAMS_CLS.get(spec.params_cls)
+    if claimed is not None and claimed.name != spec.name and not overwrite:
+        raise ValueError(
+            f"params class {spec.params_cls.__name__!r} is already claimed "
+            f"by environment {claimed.name!r}; give {spec.name!r} its own "
+            "params subclass so spec_for_params stays unambiguous"
+        )
+    if overwrite and spec.name in _REGISTRY:
+        # Drop the old params-class claim so a replacement spec with a new
+        # params type doesn't leave a stale dispatch entry behind.
+        _BY_PARAMS_CLS.pop(_REGISTRY[spec.name].params_cls, None)
+    _REGISTRY[spec.name] = spec
+    _BY_PARAMS_CLS[spec.params_cls] = spec
+
+
+def get_env(name: str) -> EnvSpec:
+    """Lookup that fails fast: unknown names raise with the valid registry
+    entries (and a did-you-mean) — never a silent formation fallback."""
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        close = difflib.get_close_matches(str(name), _REGISTRY, n=1)
+        hint = f" (did you mean {close[0]!r}?)" if close else ""
+        raise ValueError(
+            f"unknown environment {name!r}{hint}; registered environments: "
+            f"{', '.join(registered_envs())}"
+        )
+    return spec
+
+
+def spec_for_params(params) -> EnvSpec:
+    """Resolve the spec from a params instance's type (module doc).
+
+    Walks the MRO so a params *subclass* resolves to the most-derived
+    registered env (``PursuitParams`` -> pursuit_evasion, its ``EnvParams``
+    base -> formation), and an unregistered type fails fast naming the
+    registered (env, params-class) pairs.
+    """
+    for cls in type(params).__mro__:
+        spec = _BY_PARAMS_CLS.get(cls)
+        if spec is not None:
+            return spec
+    pairs = ", ".join(
+        f"{s.name} ({s.params_cls.__name__})" for s in _REGISTRY.values()
+    )
+    raise ValueError(
+        f"no registered environment for params type "
+        f"{type(params).__name__!r}; registered: {pairs} — register the "
+        "env with envs.register_env (docs/environments.md)"
+    )

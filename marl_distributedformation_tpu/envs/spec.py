@@ -1,0 +1,140 @@
+"""The environment contract: ``EnvSpec`` + declared observation layout.
+
+The JaxMARL / Jumanji idiom (PAPERS.md): many pure-JAX environments behind
+ONE ``step``/``reset`` contract, so every downstream compiled program —
+trainer, scenario engine, promotion gate, serving ladder — is env-generic.
+An ``EnvSpec`` bundles an environment's pure functions (exactly the
+signatures ``env/formation.py`` established, so the formation env rides
+behind the contract **bitwise unchanged**) plus two pieces of metadata the
+rest of the system keys on:
+
+- ``params_cls``: the env's frozen params dataclass. Downstream code never
+  takes an env name — it resolves the spec from the params it already
+  holds (``registry.spec_for_params``), so every existing call site stays
+  signature-compatible and the formation path stays the legacy path.
+- ``obs_layout(params) -> ObsLayout``: the declared per-agent observation
+  layout — named column blocks (``self`` / ``neighbor`` / ``goal`` / ...)
+  and the neighbor topology (``ring`` | ``knn``). Scenario layers that
+  index observation columns (comm dropout, obstacle occlusion) read block
+  slices from here and **fail fast** when an env doesn't declare the block
+  they need, instead of silently perturbing the wrong columns
+  (scenarios/layers.py).
+
+Contract semantics (shared by every registered env):
+
+- ``reset(key, params) -> state`` — pure; all randomness from ``key``.
+- ``step(state, velocity, params, with_obs=True) -> (state, Transition)``
+  — one formation, raw per-agent velocities (the L0 contract), auto-reset
+  on done with the episode key carried in ``state.key``.
+- ``obs(state, params) -> obs`` — recompute observations from a state
+  (shape-generic over a leading batch axis; the knn path batches the
+  neighbor search, ops/knn.py).
+- ``reset_batch(key, params, M)`` / ``step_batch(state, velocity, params)``
+  — the vmapped forms every compiled program consumes.
+
+``reset_env`` / ``step_env`` below expose the conventional gym-flavored
+view (``(state, obs)`` / ``(state, obs, reward, done, info)``) on top of
+the same primitives for new code and docs/environments.md examples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+Ranges = Tuple[Tuple[int, int], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsLayout:
+    """Declared per-agent observation layout (static, hashable).
+
+    ``blocks`` maps a block name to a tuple of half-open column ranges —
+    a tuple because one logical block may occupy disjoint ranges (the knn
+    ``neighbor`` block is offsets+distances early in the row plus the
+    trailing neighbor-index block). Stored as a tuple of pairs so the
+    layout can ride as static jit closure state.
+    """
+
+    dim: int
+    topology: str  # "ring" | "knn" — how the neighbor block is built
+    blocks: Tuple[Tuple[str, Ranges], ...]
+
+    def __post_init__(self) -> None:
+        assert self.topology in ("ring", "knn"), self.topology
+        for name, ranges in self.blocks:
+            for start, stop in ranges:
+                assert 0 <= start <= stop <= self.dim, (
+                    f"block {name!r} range ({start}, {stop}) outside "
+                    f"obs dim {self.dim}"
+                )
+
+    def names(self) -> Tuple[str, ...]:
+        return tuple(name for name, _ in self.blocks)
+
+    def block(self, name: str) -> Ranges | None:
+        for block_name, ranges in self.blocks:
+            if block_name == name:
+                return ranges
+        return None
+
+    def require(self, name: str, needed_by: str = "caller") -> Ranges:
+        """Fail fast when a needed block isn't declared — the cure for the
+        silent-mismasking hazard (a layer blanking the wrong columns)."""
+        ranges = self.block(name)
+        if ranges is None:
+            raise ValueError(
+                f"{needed_by} needs obs block {name!r}, but this env's "
+                f"declared layout only has: {', '.join(self.names())} — "
+                "declare the block in the env's obs_layout or don't apply "
+                "this layer to it"
+            )
+        return ranges
+
+    def columns(self, *names: str, needed_by: str = "caller") -> np.ndarray:
+        """Static ``(dim,)`` bool mask of the named blocks' columns (every
+        name must be declared — see ``require``)."""
+        cols = np.zeros((self.dim,), dtype=bool)
+        for name in names:
+            for start, stop in self.require(name, needed_by=needed_by):
+                cols[start:stop] = True
+        return cols
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    """A registered environment: pure functions + metadata (module doc).
+
+    Frozen (hashable) so a spec can ride as static jit closure state, like
+    the env params it dispatches on.
+    """
+
+    name: str
+    description: str
+    params_cls: type
+    # Pure functions, exactly the env/formation.py signatures (module doc).
+    reset: Callable[..., Any]  # (key, params) -> state
+    step: Callable[..., Any]  # (state, velocity, params, with_obs) -> (state, tr)
+    obs: Callable[..., Any]  # (state, params) -> obs
+    reset_batch: Callable[..., Any]  # (key, params, M) -> state
+    step_batch: Callable[..., Any]  # (state, velocity, params) -> (state, tr)
+    obs_layout: Callable[..., ObsLayout]  # (params) -> ObsLayout
+
+    # -- conventional protocol view (gym-flavored; docs/environments.md) --
+
+    def reset_env(self, key, params):
+        """``(state, obs)`` — reset plus the first observation."""
+        state = self.reset(key, params)
+        return state, self.obs(state, params)
+
+    def step_env(self, state, velocity, params):
+        """``(state, obs, reward, done, info)`` — the flat contract tuple
+        (``info`` is the transition's metrics dict)."""
+        next_state, tr = self.step(state, velocity, params)
+        return next_state, tr.obs, tr.reward, tr.done, tr.metrics
+
+    def default_params(self, **overrides):
+        """A fresh ``params_cls`` instance (keyword overrides applied)."""
+        return self.params_cls(**overrides)

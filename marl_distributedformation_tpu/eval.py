@@ -23,11 +23,7 @@ import jax.numpy as jnp
 
 from marl_distributedformation_tpu.env import EnvParams
 from marl_distributedformation_tpu.env.baseline import control
-from marl_distributedformation_tpu.env.formation import (
-    compute_obs,
-    reset_batch,
-    step_batch,
-)
+from marl_distributedformation_tpu.envs import spec_for_params
 
 Array = jax.Array
 
@@ -65,18 +61,25 @@ def run_episode_metrics(
 
     ``scenario_params`` (``scenarios.ScenarioParams`` or None) routes the
     env step through the disturbance stack; None is the clean env.
+
+    Env-generic: the environment is resolved from the params *type*
+    (``envs.spec_for_params`` — formation params resolve to the legacy
+    ``env/formation.py`` functions verbatim, so that path is bitwise
+    unchanged; ``PursuitParams`` evaluates pursuit-evasion through the
+    same compiled program structure, metrics keys included).
     """
     # Reset uses ``key`` unchanged (NOT a split): recorded eval artifacts
     # compare controllers on identical initial states across runs, so the
     # seed -> initial-state mapping must stay stable. The action-noise
     # stream is folded off it.
+    env = spec_for_params(params)
     act_key = jax.random.fold_in(key, 1)
-    state = reset_batch(key, params, num_formations)
-    obs0 = compute_obs(state.agents, state.goal, params)
+    state = env.reset_batch(key, params, num_formations)
+    obs0 = env.obs(state, params)
     T = episode_length(params)
 
     if scenario_params is None:
-        env_step = step_batch
+        env_step = env.step_batch
     else:
         from marl_distributedformation_tpu.scenarios import (
             scenario_step_batch,

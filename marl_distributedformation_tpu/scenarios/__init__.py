@@ -15,8 +15,10 @@ from marl_distributedformation_tpu.scenarios.params import (  # noqa: F401
 )
 from marl_distributedformation_tpu.scenarios.layers import (  # noqa: F401
     neighbor_obs_columns,
+    occlude_obs,
     perturb_goal,
     perturb_obs,
+    perturb_obstacles,
     perturb_velocity,
 )
 from marl_distributedformation_tpu.scenarios.engine import (  # noqa: F401

@@ -1,9 +1,14 @@
 """Scenario engine: the clean env step wrapped in the disturbance stack.
 
-``scenario_step`` composes the layers (``layers.py``) around
-``env/formation.py``'s ``step`` in a fixed order — goal transforms,
-actuator transforms, clean step, observation transforms — without forking
-the env. ``scenario_step_batch`` is the vmapped form and accepts the
+``scenario_step`` composes the layers (``layers.py``) around the
+REGISTERED env's ``step`` in a fixed order — goal transforms, obstacle
+transforms, actuator transforms, clean step, observation transforms —
+without forking any env. The env is resolved from the params type
+(``envs.spec_for_params``), a trace-time static dispatch: for formation
+params the resolved functions ARE ``env/formation.py``'s, so that path
+is bitwise identical to the pre-registry engine; any registered env
+(pursuit-evasion, tomorrow's) gets the whole disturbance stack for free.
+``scenario_step_batch`` is the vmapped form and accepts the
 scenario parameters either unbatched (every formation runs the same
 scenario — the eval shape) or with a leading ``(M,)`` axis (a mixed batch
 — the domain-randomization training shape); which one is a static
@@ -22,15 +27,16 @@ from typing import Callable, Tuple
 
 import jax
 
-from marl_distributedformation_tpu.env.formation import compute_obs, step
 from marl_distributedformation_tpu.env.types import (
     EnvParams,
     FormationState,
     Transition,
 )
+from marl_distributedformation_tpu.envs import spec_for_params
 from marl_distributedformation_tpu.scenarios.layers import (
     perturb_goal,
     perturb_obs,
+    perturb_obstacles,
     perturb_velocity,
 )
 from marl_distributedformation_tpu.scenarios.params import ScenarioParams
@@ -46,9 +52,11 @@ def scenario_step(
     with_obs: bool = True,
 ) -> Tuple[FormationState, Transition]:
     """One formation, one step, through the disturbance stack."""
+    spec = spec_for_params(params)
     state = perturb_goal(state, sp, params)
+    state = perturb_obstacles(state, sp, params)
     velocity = perturb_velocity(velocity, state, sp, params)
-    next_state, tr = step(state, velocity, params, with_obs=with_obs)
+    next_state, tr = spec.step(state, velocity, params, with_obs=with_obs)
     if with_obs:
         tr = tr.replace(obs=perturb_obs(tr.obs, next_state, sp, params))
     return next_state, tr
@@ -79,7 +87,7 @@ def scenario_step_batch(
             functools.partial(scenario_step, with_obs=False),
             in_axes=(0, 0, axis, None),
         )(state, velocity, sp, params)
-        obs = compute_obs(next_state.agents, next_state.goal, params)
+        obs = spec_for_params(params).obs(next_state, params)
         obs = jax.vmap(perturb_obs, in_axes=(0, 0, axis, None))(
             obs, next_state, sp, params
         )

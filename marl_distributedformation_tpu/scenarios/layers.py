@@ -10,10 +10,22 @@ stacks around ``env/formation.py``'s ``step`` without forking it:
 - ``perturb_velocity`` (pre-step, action transform): agent fault
   injection (per-episode frozen agents — actuator dropout), Gaussian +
   constant-bias actuator noise, and a constant + gusting wind field;
+- ``perturb_obstacles`` (pre-step, state transform): moving obstacles —
+  each obstacle drifts along its own per-episode heading, clipped to the
+  world box (the avoidance capability the reference env had and the
+  scenario engine dropped — ROADMAP item 3a);
 - ``perturb_obs`` (post-step, observation transform): Gaussian +
-  constant-bias sensor noise, and comm dropout that masks the
-  neighbor-derived observation blocks per agent per step (ring-neighbor
-  offsets in ``ring`` mode; offsets/distances/indices in ``knn`` mode).
+  constant-bias sensor noise, comm dropout that masks the
+  neighbor-derived observation blocks per agent per step, and obstacle
+  occlusion — agents within ``obstacle_occlusion`` px of an obstacle
+  lose the same neighbor blocks (obstacles as a sensing hazard).
+
+Layers that index observation columns do NOT hard-code any layout: they
+read the block slices from the env's **declared** obs layout
+(``envs.spec_for_params(params).obs_layout(params)``) and fail fast when
+an env doesn't declare the block they need (``ObsLayout.require``) —
+masking the wrong columns silently is the one failure mode this design
+exists to prevent.
 
 Randomness derives from the formation's own PRNG stream via ``fold_in``
 with per-layer salts — the env's key is read, never consumed, so the
@@ -49,6 +61,7 @@ _SALT_GOAL_SWITCH = 0x5C06
 _SALT_OBS_NOISE = 0x5C07
 _SALT_OBS_BIAS = 0x5C08
 _SALT_COMM = 0x5C09
+_SALT_OBSTACLE_DIR = 0x5C0A
 
 
 def _episode_key(state: FormationState, salt: int) -> Array:
@@ -93,6 +106,34 @@ def perturb_goal(
     return state.replace(goal=goal)
 
 
+def perturb_obstacles(
+    state: FormationState, sp: ScenarioParams, params: EnvParams
+) -> FormationState:
+    """Pre-step obstacle transform: moving obstacles.
+
+    Each obstacle drifts ``obstacle_speed`` px/step along its own
+    per-episode heading, clipped to the world box. The drift is applied
+    to the state the env step consumes, so the perturbed positions carry
+    forward through the episode (accumulating motion) and reset with the
+    formation — the env's collision penalty and the occlusion layer both
+    see the moved obstacles. Identity (bitwise, and shape-trivially) when
+    the env has no obstacles or ``obstacle_speed`` is 0.
+    """
+    if params.num_obstacles == 0:
+        return state  # static shape property — nothing to move
+    k_dir = _episode_key(state, _SALT_OBSTACLE_DIR)
+    theta = jax.random.uniform(
+        k_dir, (params.num_obstacles,), minval=0.0, maxval=2.0 * jnp.pi
+    )
+    headings = jnp.stack([jnp.cos(theta), jnp.sin(theta)], axis=-1)
+    wh = jnp.array([params.width, params.height], jnp.float32)
+    moved = jnp.clip(
+        state.obstacles + sp.obstacle_speed * headings, 0.0, wh
+    )
+    obstacles = jnp.where(sp.obstacle_speed > 0, moved, state.obstacles)
+    return state.replace(obstacles=obstacles)
+
+
 def perturb_velocity(
     velocity: Array, state: FormationState, sp: ScenarioParams, params: EnvParams
 ) -> Array:
@@ -129,27 +170,50 @@ def perturb_velocity(
     return jnp.where(windy, blown, velocity)
 
 
-def neighbor_obs_columns(params: EnvParams) -> np.ndarray:
-    """Static ``(obs_dim,)`` mask of the neighbor-derived observation
-    columns — what comm dropout blanks. ``ring``: the prev/next offset
-    blocks (layout in ``compute_obs``). ``knn``: the k-neighbor
-    offsets/distances plus the trailing index block (layout in
-    ``_assemble_knn_obs``). Own position and the goal stay visible —
-    dropped comm, not a dead sensor."""
-    cols = np.zeros((params.obs_dim,), dtype=bool)
-    if params.obs_mode == "ring":
-        cols[2:6] = True
-    else:
-        k = params.knn_k
-        cols[2 : 2 + 3 * k] = True
-        cols[params.obs_dim - k :] = True
-    return cols
+def neighbor_obs_columns(
+    params: EnvParams, needed_by: str = "comm dropout"
+) -> np.ndarray:
+    """Static ``(obs_dim,)`` mask of the env's DECLARED neighbor
+    observation block — what comm dropout and obstacle occlusion blank.
+    Read from the registered env's obs-layout metadata (never hard-coded
+    column numbers: the formation layout baked in here once was a silent
+    mismasking hazard for any other env). An env that doesn't declare a
+    ``neighbor`` block fails fast naming the blocks it does declare
+    (``envs.ObsLayout.require``). Own position and the goal/pursuer block
+    stay visible — dropped comm, not a dead sensor."""
+    from marl_distributedformation_tpu.envs import spec_for_params
+
+    layout = spec_for_params(params).obs_layout(params)
+    return layout.columns("neighbor", needed_by=needed_by)
+
+
+def occlude_obs(
+    obs: Array, state: FormationState, sp: ScenarioParams, params: EnvParams
+) -> Array:
+    """Obstacle occlusion: agents within ``obstacle_occlusion`` px of any
+    obstacle lose their neighbor observation blocks — obstacles as a
+    sensing hazard (the static obstacle-field layer), deterministic
+    geometry with no RNG. The masked columns come from the env's declared
+    layout, same discipline as comm dropout."""
+    if params.num_obstacles == 0:
+        return obs  # static shape property — nothing to occlude behind
+    dists = jnp.linalg.norm(
+        state.agents[..., :, None, :] - state.obstacles[..., None, :, :],
+        axis=-1,
+    )
+    occluded = dists.min(axis=-1) < sp.obstacle_occlusion
+    cols = jnp.asarray(
+        neighbor_obs_columns(params, needed_by="obstacle occlusion")
+    )
+    masked = jnp.where(occluded[..., None] & cols, 0.0, obs)
+    return jnp.where(sp.obstacle_occlusion > 0, masked, obs)
 
 
 def perturb_obs(
     obs: Array, state: FormationState, sp: ScenarioParams, params: EnvParams
 ) -> Array:
-    """Post-step observation transforms: sensor noise -> comm dropout.
+    """Post-step observation transforms: sensor noise -> comm dropout ->
+    obstacle occlusion.
 
     ``state`` is the post-step state the observation belongs to; only the
     *observed* values change — rewards, metrics, and the physical state
@@ -171,4 +235,6 @@ def perturb_obs(
         k_drop, jnp.clip(sp.comm_drop_prob, 0.0, 1.0), (obs.shape[-2],)
     )
     masked = jnp.where(dropped[..., None] & cols, 0.0, obs)
-    return jnp.where(sp.comm_drop_prob > 0, masked, obs)
+    obs = jnp.where(sp.comm_drop_prob > 0, masked, obs)
+
+    return occlude_obs(obs, state, sp, params)

@@ -42,6 +42,9 @@ class ScenarioParams:
     obs_noise_sigma: jax.Array  # () obs units — Gaussian sensor noise
     obs_bias: jax.Array  # () obs units — constant per-episode sensor bias
     comm_drop_prob: jax.Array  # () in [0,1] — per-step neighbor-block dropout
+    obstacle_speed: jax.Array  # () px/step — obstacle drift (moving obstacles)
+    obstacle_occlusion: jax.Array  # () px — neighbor-obs blackout radius
+    #   around obstacles (static obstacle field as a sensing hazard)
 
     @classmethod
     def zeros(cls) -> "ScenarioParams":
@@ -58,6 +61,8 @@ class ScenarioParams:
             obs_noise_sigma=z,
             obs_bias=z,
             comm_drop_prob=z,
+            obstacle_speed=z,
+            obstacle_occlusion=z,
         )
 
 

@@ -70,6 +70,8 @@ class ScenarioSpec:
     obs_noise_sigma: float = 0.0
     obs_bias: float = 0.0
     comm_drop_prob: float = 0.0
+    obstacle_speed: float = 0.0
+    obstacle_occlusion: float = 0.0
 
     def build(self, severity) -> ScenarioParams:
         """Scale the severity-1 magnitudes by a traced ``severity``
@@ -94,6 +96,8 @@ class ScenarioSpec:
             obs_noise_sigma=scaled(self.obs_noise_sigma),
             obs_bias=scaled(self.obs_bias),
             comm_drop_prob=jnp.clip(scaled(self.comm_drop_prob), 0.0, 1.0),
+            obstacle_speed=scaled(self.obstacle_speed),
+            obstacle_occlusion=scaled(self.obstacle_occlusion),
         )
 
 
@@ -145,6 +149,24 @@ _DEFAULT_SPECS: Tuple[ScenarioSpec, ...] = (
         "lossy comms: each agent's neighbor observation blocks blank "
         "with prob 0.5*severity per step",
         comm_drop_prob=0.5,
+    ),
+    # Obstacle-field layers (ROADMAP item 3a). Both are identity when the
+    # env has no obstacles (num_obstacles is a static shape property) —
+    # train/evaluate with num_obstacles > 0 to give them teeth.
+    ScenarioSpec(
+        "obstacle_field",
+        "static obstacle field as a sensing hazard: agents within "
+        "80*severity px of an obstacle lose their neighbor obs blocks "
+        "(avoidance pressure comes from the env's obstacle penalty; "
+        "needs num_obstacles > 0)",
+        obstacle_occlusion=80.0,
+    ),
+    ScenarioSpec(
+        "moving_obstacles",
+        "obstacles drift 3*severity px/step along per-episode headings "
+        "(clipped to the world) — moving obstacle avoidance; needs "
+        "num_obstacles > 0",
+        obstacle_speed=3.0,
     ),
     ScenarioSpec(
         "storm",

@@ -71,10 +71,7 @@ from flax.training.train_state import TrainState
 
 from marl_distributedformation_tpu.algo import PPOConfig
 from marl_distributedformation_tpu.env import EnvParams
-from marl_distributedformation_tpu.env.formation import (
-    compute_obs,
-    reset_batch,
-)
+from marl_distributedformation_tpu.envs import spec_for_params
 from marl_distributedformation_tpu.jax_compat import shard_map
 from marl_distributedformation_tpu.models import MLPActorCritic
 from marl_distributedformation_tpu.train.recovery import record_health_flags
@@ -173,6 +170,10 @@ class SweepTrainer:
         # horizon formula applies unchanged (bit-compat with Trainer).
         ppo = fill_ent_schedule(ppo, env_params, config)
         self.env_params = env_params
+        # Env-generic dispatch (envs/): formation params resolve to the
+        # legacy env/formation.py functions verbatim, so member i stays
+        # bit-identical to Trainer(seed=config.seed + i) on the default env.
+        self.env_spec = spec_for_params(env_params)
         self.ppo = ppo
         self.config = config
         self.num_seeds = num_seeds
@@ -190,6 +191,7 @@ class SweepTrainer:
             dummy_obs = jnp.zeros((1, env_params.obs_dim), jnp.float32)
 
         model_ref = self.model  # close over the module, not self
+        env_spec = self.env_spec  # likewise — init_member is jit/vmapped
 
         self._lr_sweep = learning_rates is not None
         if self._lr_sweep:
@@ -235,8 +237,8 @@ class SweepTrainer:
                 train_state = train_state.replace(
                     opt_state=(clip_s, inject_s)
                 )
-            env_state = reset_batch(k_env, env_params, m)
-            obs = compute_obs(env_state.agents, env_state.goal, env_params)
+            env_state = env_spec.reset_batch(k_env, env_params, m)
+            obs = env_spec.obs(env_state, env_params)
             return train_state, env_state, obs, key
 
         self._mesh = mesh

@@ -37,7 +37,8 @@ from marl_distributedformation_tpu.chaos.plane import (
     fault_point,
 )
 from marl_distributedformation_tpu.env import EnvParams
-from marl_distributedformation_tpu.env.formation import compute_obs, reset_batch
+from marl_distributedformation_tpu.env.formation import compute_obs
+from marl_distributedformation_tpu.envs import spec_for_params
 from marl_distributedformation_tpu.models import MLPActorCritic
 from marl_distributedformation_tpu.obs.metrics import get_registry
 from marl_distributedformation_tpu.utils import profiling
@@ -352,6 +353,11 @@ class Trainer:
     ) -> None:
         ppo = fill_ent_schedule(ppo, env_params, config)
         self.env_params = env_params
+        # Env-generic dispatch (envs/): resolved from the params TYPE, so
+        # formation params route to the legacy env/formation.py functions
+        # verbatim (bitwise-identical path) and any registered env trains
+        # through the same compiled program structure.
+        self.env_spec = spec_for_params(env_params)
         self.ppo = ppo
         self.config = config
         self.num_envs = config.num_formations * env_params.num_agents
@@ -393,6 +399,19 @@ class Trainer:
         # locally per slab.
         self._env_step_fn = None
         mesh = getattr(shard_fn, "mesh", None)
+        if (
+            mesh is not None or jax.process_count() > 1
+        ) and self.env_spec.name != "formation":
+            # The mesh-specialized steps (sp ring halo exchange, dp-mesh
+            # shard_map knn) and the multi-host sharded reset are built
+            # from formation functions — fail fast instead of silently
+            # training the wrong env through them.
+            raise SystemExit(
+                f"env {self.env_spec.name!r} does not compose with mesh "
+                "sharding / multi-host yet (the sharded env steps in "
+                "parallel/ are formation-specialized); drop the mesh or "
+                "use env=formation"
+            )
         if mesh is not None and "sp" in mesh.shape:
             from marl_distributedformation_tpu.parallel import make_ring_step
 
@@ -426,14 +445,13 @@ class Trainer:
             )(self.env_state.agents, self.env_state.goal)
             self.train_state = replicate(self.train_state, mesh)
         else:
-            self.env_state = reset_batch(
+            self.env_state = self.env_spec.reset_batch(
                 k_env, env_params, config.num_formations
             )
-            # compute_obs is shape-generic over the leading formation axis
-            # and routes knn obs through the batched (Pallas-capable) search.
-            self.obs = compute_obs(
-                self.env_state.agents, self.env_state.goal, env_params
-            )
+            # The spec's obs is shape-generic over the leading formation
+            # axis and routes knn obs through the batched (Pallas-capable)
+            # search — for formation these ARE reset_batch/compute_obs.
+            self.obs = self.env_spec.obs(self.env_state, env_params)
             if shard_fn is not None:
                 self.train_state, self.env_state, self.obs = shard_fn(
                     self.train_state, self.env_state, self.obs

@@ -165,6 +165,17 @@ def scenario_schedule_from_config(cfg: Config):
     )
 
 
+def _env_spec_or_exit(name: str):
+    """Resolve a registered env by name, converting the registry's
+    ValueError (did-you-mean + listing) into the entry-point SystemExit."""
+    from marl_distributedformation_tpu.envs import get_env
+
+    try:
+        return get_env(str(name))
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
+
+
 def validate_override_keys(
     overrides: Iterable[str],
     extra_keys: Iterable[str] = (),
@@ -178,16 +189,30 @@ def validate_override_keys(
     the default (e.g. the clean env), which is exactly the failure mode
     this guards. Valid keys = the YAML defaults + ``extra_keys``; dotted
     overrides validate their top-level segment."""
+    overrides = list(overrides)
     path = Path(config_path)
     if not path.is_absolute() and not path.exists():
         path = repo_root() / config_path
     with open(path) as f:
-        known = set(yaml.safe_load(f) or {})
-    # Every EnvParams field is honored by env_params_from_config even when
-    # the YAML defaults omit it (e.g. max_steps) — all are valid overrides.
-    from marl_distributedformation_tpu.env import EnvParams
-
-    known |= {f.name for f in dataclasses.fields(EnvParams)}
+        data = yaml.safe_load(f) or {}
+    known = set(data)
+    # Every field of the SELECTED env's params class is honored by
+    # env_params_from_config even when the YAML defaults omit it (e.g.
+    # max_steps, pursuer_speed) — all are valid overrides. Peek the env=
+    # override the same way load_config peeks preset=, so a mistyped env
+    # name fails here with the registry's did-you-mean, and env-specific
+    # knobs (PursuitParams.capture_radius, ...) validate precisely.
+    env_name = next(
+        (
+            _parse_value(o.split("=", 1)[1])
+            for o in reversed(overrides)
+            if "=" in o and o.split("=", 1)[0] == "env"
+        ),
+        data.get("env", "formation"),
+    )
+    spec = _env_spec_or_exit(env_name)
+    known |= {f.name for f in dataclasses.fields(spec.params_cls)}
+    known |= {"env"}
     known |= set(extra_keys)
     for item in overrides:
         if "=" not in item:
@@ -205,12 +230,18 @@ def validate_override_keys(
 
 
 def env_params_from_config(cfg: Config):
-    """Build ``EnvParams`` from the flat config, forwarding every knob —
+    """Build env params from the flat config, forwarding every knob —
     including ``share_reward_ratio``, which the reference silently drops
-    (SURVEY.md Q6)."""
-    from marl_distributedformation_tpu.env import EnvParams
+    (SURVEY.md Q6).
 
-    fields = {f.name for f in dataclasses.fields(EnvParams)}
+    The ``env`` key (cfg/config.yaml) selects which REGISTERED environment's
+    params class to build (``envs.get_env`` — unknown names exit with the
+    registry's did-you-mean), so ``env=pursuit_evasion`` routes every env
+    consumer (train.py, evaluate.py, the robustness matrix) through
+    ``envs.spec_for_params`` dispatch with no further plumbing. Default is
+    the formation env, whose params class is the legacy ``EnvParams``."""
+    spec = _env_spec_or_exit(cfg.get("env", "formation"))
+    fields = {f.name for f in dataclasses.fields(spec.params_cls)}
     kwargs = {
         "num_agents": cfg.num_agents_per_formation,
         "share_reward_ratio": cfg.share_reward_ratio,
@@ -219,4 +250,4 @@ def env_params_from_config(cfg: Config):
     for key in fields:
         if key in cfg and key not in ("num_agents",):
             kwargs[key] = cfg[key]
-    return EnvParams(**kwargs)
+    return spec.params_cls(**kwargs)

@@ -755,6 +755,59 @@ def _sebulba_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _envs_problems(rec: dict) -> list[str]:
+    """Structural validation of the registered-env ladder fields (bench
+    phase 1d), whenever present: every per-env rate a finite positive
+    number (a zero rate means that env never stepped); the per-env pair
+    recorded together (the phase times every registered env, so one rate
+    without the other means the loop died mid-ladder); and
+    obstacle_overhead_pct a finite number in [0, 100] (the occlusion
+    layer can only cost, never accelerate, and cannot eat more than the
+    whole rate). ``"skipped"`` sentinels honored as structurally
+    absent."""
+    problems = []
+    env_keys = (
+        "env_steps_per_sec_formation",
+        "env_steps_per_sec_pursuit_evasion",
+    )
+    present = {}
+    for key in env_keys:
+        v = _present(rec, key)
+        if v is None:
+            continue
+        present[key] = v
+        try:
+            f = float(v)
+            if not math.isfinite(f) or f <= 0.0:
+                problems.append(
+                    f"{key}={v!r} (need a finite number > 0 — a zero "
+                    "rate means that env never stepped)"
+                )
+        except (TypeError, ValueError):
+            problems.append(f"{key} is not a number: {v!r}")
+    if len(present) == 1:
+        problems.append(
+            "registered-env ladder incomplete: got only "
+            f"{sorted(present)} — the phase times every registered env, "
+            "so a lone rate means the ladder died mid-loop"
+        )
+    overhead = _present(rec, "obstacle_overhead_pct")
+    if overhead is not None:
+        try:
+            f = float(overhead)
+            if not math.isfinite(f) or not 0.0 <= f <= 100.0:
+                problems.append(
+                    f"obstacle_overhead_pct={overhead!r} (need a finite "
+                    "number in [0, 100]: the occlusion layer can only "
+                    "cost, never accelerate)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"obstacle_overhead_pct is not a number: {overhead!r}"
+            )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -778,6 +831,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_mesh_problems(rec))
     problems.extend(_lint_problems(rec))
     problems.extend(_sebulba_problems(rec))
+    problems.extend(_envs_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(

@@ -476,6 +476,39 @@ def test_check_bench_record_gates():
     assert check({**clean, "graftlint_wall_s": 500.0}, [], [])
     assert check({**clean, "graftlint_wall_s": "slow"}, [], [])
     assert check({**clean, "graftlint_wall_s": "skipped"}, [], []) == []
+    # Registered-env ladder fields (bench phase 1d), validated whenever
+    # present: both per-env rates finite positive AND recorded together
+    # (a lone rate means the ladder died mid-loop), obstacle overhead a
+    # finite number in [0, 100], "skipped" sentinels honored.
+    envs_ok = {
+        **clean,
+        "env_steps_per_sec_formation": 1.6e6,
+        "env_steps_per_sec_pursuit_evasion": 1.5e6,
+        "obstacle_overhead_pct": 12.3,
+    }
+    assert check(envs_ok, [], []) == []
+    assert check({**envs_ok, "env_steps_per_sec_formation": 0.0}, [], [])
+    assert check(
+        {**envs_ok, "env_steps_per_sec_pursuit_evasion": "fast"}, [], []
+    )
+    lone = dict(envs_ok)
+    del lone["env_steps_per_sec_pursuit_evasion"]
+    assert check(lone, [], [])  # ladder died mid-loop
+    assert check({**envs_ok, "obstacle_overhead_pct": -3.0}, [], [])
+    assert check({**envs_ok, "obstacle_overhead_pct": 101.0}, [], [])
+    assert check(
+        {**envs_ok, "obstacle_overhead_pct": float("nan")}, [], []
+    )
+    assert check({**envs_ok, "obstacle_overhead_pct": "cheap"}, [], [])
+    assert check(
+        {
+            **clean,
+            "env_steps_per_sec_formation": "skipped",
+            "env_steps_per_sec_pursuit_evasion": "skipped",
+            "obstacle_overhead_pct": "skipped",
+        },
+        [], [],
+    ) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

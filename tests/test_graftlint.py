@@ -1648,6 +1648,51 @@ FIXTURES = [
                 out.append(_place(item, device))
         """,
     ),
+    (
+        "env-contract-impurity",
+        """
+        import numpy as np
+
+        def step(state, velocity, params):
+            noise = np.random.normal(size=velocity.shape)  # host RNG
+            return state, velocity + noise
+        """,
+        """
+        import jax, jax.numpy as jnp
+        import numpy as np
+
+        def step(state, velocity, params):
+            key, k = jax.random.split(state.key)
+            noise = jax.random.normal(k, velocity.shape)
+            return state.replace(key=key), velocity + noise
+
+        def make_table():
+            # host RNG OUTSIDE the env contract surface: allowed
+            return np.random.normal(size=(4,))
+        """,
+    ),
+    (
+        "env-contract-impurity",
+        """
+        _EPISODES = 0
+
+        def reset(key, params):
+            global _EPISODES  # trace-time rebind
+            _EPISODES += 1
+            return _EPISODES
+        """,
+        """
+        import random
+        from jax import random as jrandom
+
+        def reset(key, params):
+            # `random` here is jax.random under an alias: allowed
+            return jrandom.uniform(key, (params.num_agents, 2))
+
+        def pick_seed():
+            return random.randint(0, 100)  # host code path: allowed
+        """,
+    ),
 ]
 
 
@@ -1730,6 +1775,25 @@ def test_package_scan_covers_analysis_engine():
     analysis = {f.name for f in files if "analysis" in f.parts}
     assert {"callgraph.py", "linter.py", "graftlock.py"} <= analysis, (
         f"analysis/ engine missing from the lint scan: {analysis}"
+    )
+
+
+def test_package_scan_covers_envs():
+    """The zero-violation pin must include the envs/ subsystem — the
+    env-contract-impurity rule's subject (registered step/reset
+    implementations) lives there, and a future exclude entry cannot
+    silently drop it from the scan."""
+    from marl_distributedformation_tpu.analysis import load_config
+    from marl_distributedformation_tpu.analysis.linter import iter_python_files
+
+    files = list(iter_python_files([PACKAGE], load_config(REPO), root=REPO))
+    envs = {f.name for f in files if "envs" in f.parts}
+    assert {
+        "spec.py", "registry.py", "formation.py", "pursuit.py",
+    } <= envs, f"envs/ missing from the lint scan: {envs}"
+    legacy = {f.name for f in files if "env" in f.parts}
+    assert "formation.py" in legacy, (
+        f"legacy env/ missing from the scan: {legacy}"
     )
 
 

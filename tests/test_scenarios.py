@@ -13,6 +13,7 @@ The two load-bearing invariants:
    x same-architecture checkpoint (budget-1 RetraceGuard on both).
 """
 
+import dataclasses
 import json
 from pathlib import Path
 
@@ -48,9 +49,13 @@ M, N, STEPS = 3, 4, 8
 PARAMS = EnvParams(num_agents=N, max_steps=6)
 
 
+_ROW_FIELDS = ("agents", "goal", "obstacles", "obs", "reward", "done")
+
+
 def _rollout(params, step_fn, num_steps=STEPS, m=M, seed=0):
     """Drive ``step_fn(state, velocity)`` with a shared random action
-    stream; returns stacked (agents, goal, obs, reward, done) rows."""
+    stream; returns per-step ``_ROW_FIELDS`` tuples (obstacles included
+    so the moving-obstacle layer has a recorded discriminator)."""
     state = reset_batch(jax.random.PRNGKey(seed), params, m)
     key = jax.random.PRNGKey(7)
     rows = []
@@ -62,7 +67,10 @@ def _rollout(params, step_fn, num_steps=STEPS, m=M, seed=0):
         state, tr = step_fn(state, vel)
         rows.append(
             jax.device_get(
-                (state.agents, state.goal, tr.obs, tr.reward, tr.done)
+                (
+                    state.agents, state.goal, state.obstacles,
+                    tr.obs, tr.reward, tr.done,
+                )
             )
         )
     return rows
@@ -116,9 +124,7 @@ def test_severity_zero_is_bitwise_clean_trajectory(name):
     clean = _rollout(PARAMS, lambda s, v: step_batch(s, v, PARAMS))
     scen = _rollout(PARAMS, _scenario_step_fn(PARAMS, name, 0.0))
     for t, (c_row, s_row) in enumerate(zip(clean, scen)):
-        for c, s, what in zip(
-            c_row, s_row, ("agents", "goal", "obs", "reward", "done")
-        ):
+        for c, s, what in zip(c_row, s_row, _ROW_FIELDS):
             assert np.array_equal(np.asarray(c), np.asarray(s)), (
                 f"{name} severity=0 diverged from clean at step {t} "
                 f"({what}) — must be bitwise identical"
@@ -129,12 +135,20 @@ def test_severity_zero_is_bitwise_clean_trajectory(name):
     "name", [n for n in registered_scenarios() if n != "clean"]
 )
 def test_severity_one_perturbs_the_trajectory(name):
-    clean = _rollout(PARAMS, lambda s, v: step_batch(s, v, PARAMS))
-    scen = _rollout(PARAMS, _scenario_step_fn(PARAMS, name, 1.0))
+    # The obstacle layers are (documented) identities on an env with no
+    # obstacles — give them something to move / occlude behind.
+    params = (
+        dataclasses.replace(PARAMS, num_obstacles=4)
+        if name in ("obstacle_field", "moving_obstacles")
+        else PARAMS
+    )
+    clean = _rollout(params, lambda s, v: step_batch(s, v, params))
+    scen = _rollout(params, _scenario_step_fn(params, name, 1.0))
     assert any(
-        not np.array_equal(np.asarray(c_row[2]), np.asarray(s_row[2]))
+        not np.array_equal(np.asarray(c), np.asarray(s))
         for c_row, s_row in zip(clean, scen)
-    ), f"{name} at severity 1 must change the observed trajectory"
+        for c, s in zip(c_row, s_row)
+    ), f"{name} at severity 1 must change the trajectory"
 
 
 def test_severity_zero_identity_knn_obs_mode():

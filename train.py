@@ -275,11 +275,20 @@ def build_hetero_trainer(cfg, env_params, ppo, train_cfg, shard_fn,
     ``num_seeds > 1``, K candidate seeds of the full curriculum train in
     one vmapped program (train/hetero_sweep.py) — the det-gate candidate
     selection workflow (docs/acceptance/hetero5/)."""
+    from marl_distributedformation_tpu.envs import spec_for_params
     from marl_distributedformation_tpu.train import (
         HeteroTrainer,
         curriculum_from_cfg,
     )
 
+    env_name = spec_for_params(env_params).name
+    if env_name != "formation":
+        raise SystemExit(
+            f"curriculum training is formation-only (the hetero padded-"
+            f"formation machinery wraps env/hetero.py, not the registered-"
+            f"env dispatch); env={env_name!r} does not compose — drop "
+            "curriculum or set env=formation"
+        )
     policy = cfg.get("policy", "mlp")
     if policy not in ("mlp", "ctde"):
         raise SystemExit(
