@@ -107,6 +107,14 @@ def chrome_trace(
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _REPLICA_KEY = re.compile(r"^replica(\d+)_(.+)$")
+# Tenant-lane keys (serving/tenancy): ``model_{id}__{metric}`` folds
+# into a ``model_{metric}`` family with a ``model`` label — per-tenant
+# series are one label dimension on one family, not a key explosion
+# per lane (the PR-9 label-folding discipline). The delimiter is the
+# FIRST double underscore: lane names forbid ``__``
+# (tenancy/directory.py), so the split is unambiguous whatever the
+# metric remainder contains.
+_MODEL_KEY = re.compile(r"^model_(.+?)__(.+)$")
 # Per-rung serving gauges (fleet/metrics.py): rung size + inference
 # dtype (+ engine kind, where the key carries one — both kinds can
 # serve the same rung, so e.g. compile receipts need the attribution)
@@ -174,7 +182,9 @@ def prometheus_exposition(
 
     ``replica{i}_{metric}`` keys fold into one ``{metric}`` family with
     a ``replica="i"`` label (per-replica series belong under one metric
-    name, not N names); ``rung{B}_{dtype}_{metric}`` keys fold into a
+    name, not N names); ``model_{id}__{metric}`` keys (tenant lanes,
+    serving/tenancy) fold into a ``model_{metric}`` family with a
+    ``model`` label; ``rung{B}_{dtype}_{metric}`` keys fold into a
     ``rung_{metric}`` family with ``rung``/``dtype`` labels (the
     serving ladder's shard/bf16 gauges); ``{metric}_p50/_p95/_p99``
     percentile triples (registry histograms, serving latency keys) fold
@@ -194,6 +204,7 @@ def prometheus_exposition(
         except (TypeError, ValueError):
             continue
         m = _REPLICA_KEY.match(key)
+        model = _MODEL_KEY.match(key)
         rung_kind = _RUNG_KIND_KEY.match(key)
         rung = _RUNG_KEY.match(key)
         quantile = _QUANTILE_KEY.match(key)
@@ -208,6 +219,19 @@ def prometheus_exposition(
                 quantile = pq  # summary-typed family
             else:
                 metric = f"program_{field}"
+                quantile = None
+        elif model:
+            rest = model.group(2)
+            extra = [("model", model.group(1))]
+            mq = _QUANTILE_KEY.match(rest)
+            if mq:
+                # A per-lane percentile triple composes both folds:
+                # one summary family, model AND quantile labels.
+                metric = "model_" + mq.group(1) + (mq.group(3) or "")
+                extra.append(("quantile", _QUANTILES[mq.group(2)]))
+                quantile = mq
+            else:
+                metric = f"model_{rest}"
                 quantile = None
         elif m:
             metric, extra = m.group(2), [("replica", m.group(1))]

@@ -54,22 +54,33 @@ from marl_distributedformation_tpu.utils.checkpoint import checkpoint_step
 #       ``commit_round`` (the coordinator's monotone round number), so
 #       the audit log attributes every swap to the cross-host commit
 #       that served it.
-PROMOTIONS_SCHEMA = 4
+#   5 — tenant lanes (serving/tenancy/): EVERY line carries
+#       ``model_id`` — the named lane this pipeline promotes into
+#       (None for a single-model pipeline). N independent pipelines
+#       promoting into one fleet write N logs; the stamp is what lets
+#       a merged audit view attribute each verdict to its lane.
+PROMOTIONS_SCHEMA = 5
 
 # Schemas the reader accepts. Older lines stay readable forever: the
 # reader backfills ``trace_id``/``spans`` (schema 2), ``falsifiers``
-# (schema 3), and ``host_count``/``commit_round`` (schema 4) as None.
-READABLE_SCHEMAS = (1, 2, 3, 4)
+# (schema 3), ``host_count``/``commit_round`` (schema 4), and
+# ``model_id`` (schema 5) as None.
+READABLE_SCHEMAS = (1, 2, 3, 4, 5)
 
 
 class PromotionLog:
     """Append-only JSONL verdict log. Every line carries ``schema``,
     ``event`` (``promoted`` / ``rejected`` / ``rolled_back`` /
     ``curriculum_updated`` / ...), and ``time`` (epoch seconds); the
-    rest is the event's payload."""
+    rest is the event's payload. ``model_id`` names the tenant lane
+    this log's pipeline promotes into (schema 5) — stamped on every
+    line, None for a single-model pipeline."""
 
-    def __init__(self, path: str | Path) -> None:
+    def __init__(
+        self, path: str | Path, model_id: str | None = None
+    ) -> None:
         self.path = Path(path)
+        self.model_id = model_id
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
 
@@ -78,6 +89,7 @@ class PromotionLog:
             "schema": PROMOTIONS_SCHEMA,
             "event": event,
             "time": round(time.time(), 3),
+            "model_id": self.model_id,
             **fields,
         }
         line = json.dumps(record)
@@ -121,6 +133,9 @@ class PromotionLog:
             # carry them either.
             rec.setdefault("host_count", None)
             rec.setdefault("commit_round", None)
+            # Schema 5: pre-tenancy logs are single-model by
+            # construction — their lane is the None lane.
+            rec.setdefault("model_id", None)
             records.append(rec)
         return records
 

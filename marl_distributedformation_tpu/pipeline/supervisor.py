@@ -112,7 +112,13 @@ class AlwaysLearningPipeline:
         start_after_step: int = -1,
         feedback_rollouts: int = 50,
         gate_device=None,
+        model_id: Optional[str] = None,
     ) -> None:
+        # The tenant lane this pipeline promotes into (serving/tenancy):
+        # stamped on every promotions.jsonl line (schema 5) and sent
+        # with the first-serve probe so a lane-keyed fleet routes it
+        # down the right lane. None = single-model pipeline, unchanged.
+        self.model_id = model_id
         self.log_dir = Path(log_dir)
         self.env_params = env_params  # sized requests (first-serve probe)
         self.stream = CheckpointStream(
@@ -130,7 +136,9 @@ class AlwaysLearningPipeline:
             else self.log_dir / "promoted"
         )
         self.promoter = Promoter(self.promoted_dir)
-        self.log = PromotionLog(self.log_dir / "promotions.jsonl")
+        self.log = PromotionLog(
+            self.log_dir / "promotions.jsonl", model_id=model_id
+        )
         self.router: Optional[Any] = None
         self.coordinator: Optional[Any] = None
         self.monitor: Optional[RollbackMonitor] = None
@@ -363,9 +371,13 @@ class AlwaysLearningPipeline:
         t0 = time.perf_counter()
         try:
             obs = np.zeros((1, self.env_params.obs_dim), np.float32)
-            result = self.router.submit(obs, trace_id=tr.trace_id).result(
-                timeout=self.router.default_timeout_s + 5.0
+            kwargs = (
+                {} if self.model_id is None
+                else {"model_id": self.model_id}
             )
+            result = self.router.submit(
+                obs, trace_id=tr.trace_id, **kwargs
+            ).result(timeout=self.router.default_timeout_s + 5.0)
             done = time.perf_counter()
             tr.add("first_serve_s", done - t0)
             get_tracer().add_span(

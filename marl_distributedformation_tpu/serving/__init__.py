@@ -36,6 +36,12 @@ is compiled:
   param placement (``match_partition_rules`` /
   ``make_shard_and_gather_fns``), batch-axis request sharding, optional
   bf16 rungs. ``ShardedSpec`` plugs it into a ``FleetRouter``.
+- ``serving.tenancy`` — named model lanes over one fleet:
+  ``TenantDirectory`` declares lanes (env, architecture, SLO class,
+  promoted dir), ``TenantFleet`` serves them — same-arch lanes share
+  compiled rung executables, per-lane admission queues, per-lane
+  reload coordinators with per-model step monotonicity,
+  ``run_tenant_smoke`` for the isolation evidence.
 - ``serving.loadgen`` / ``serving.autotune`` — the earned ladder:
   open-loop traffic replay measuring req/s AT a p95 target
   (``max_rate_at_slo``), and a deterministic ladder autotuner deriving

@@ -12,14 +12,19 @@ Protocol (all bodies JSON):
 
 - ``POST /v1/act`` with ``{"obs": [[...row...], ...],
   "deterministic": true, "timeout_s": 5.0,
-  "slo_class": "interactive"}`` →
+  "slo_class": "interactive", "model_id": "lane-a"}`` →
   ``200 {"actions": [...], "model_step": N, "replica": i,
-  "latency_s": x}``. ``model_step`` rides on every response — the
-  fleet's version-pinning contract, end to end. ``slo_class``
-  (optional, default "interactive") is the admission class: "batch"
-  traffic yields to interactive under backpressure (scheduler SLO
-  classes — it dispatches behind queued interactive work and may be
-  preempted with a 429 when an interactive request needs its slot).
+  "latency_s": x, "model_id": "lane-a"}``. ``model_step`` rides on
+  every response — the fleet's version-pinning contract, end to end.
+  ``slo_class`` (optional, default "interactive") is the admission
+  class: "batch" traffic yields to interactive under backpressure
+  (scheduler SLO classes — it dispatches behind queued interactive
+  work and may be preempted with a 429 when an interactive request
+  needs its slot). ``model_id`` names the tenant lane
+  (serving/tenancy) — required by multi-tenant routers, rejected
+  (400) by single-model ones — and is stamped on EVERY act response,
+  success and failure alike, so a client juggling lanes can always
+  attribute an answer (or a 429) to the lane that produced it.
 - Backpressure → ``429`` with ``{"error": "backpressure",
   "retry_after_s": x}`` AND a standard ``Retry-After`` header (integer
   ceiling), so both JSON-aware clients and off-the-shelf HTTP retry
@@ -130,19 +135,26 @@ def _make_handler(router: FleetRouter):
         def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
             if self.path == "/v1/health":
                 healthy = router.healthy_replicas
-                self._reply(
-                    200 if healthy else 503,
-                    {
-                        "healthy_replicas": healthy,
-                        "replicas": len(router.replicas),
-                        "model_step": int(
-                            max(
-                                r.registry.active_step
-                                for r in router.replicas
-                            )
-                        ),
-                    },
-                )
+                payload = {
+                    "healthy_replicas": healthy,
+                    "replicas": len(router.replicas),
+                    "model_step": int(
+                        max(
+                            r.registry.active_step
+                            for r in router.replicas
+                        )
+                    ),
+                }
+                if getattr(router, "lane_ids", ()):
+                    # Tenant lanes: per-model steps (each monotonic on
+                    # its own), and model_step is the newest any lane
+                    # serves.
+                    steps = router.lane_steps()
+                    payload["model_steps"] = {
+                        mid: int(s) for mid, s in steps.items()
+                    }
+                    payload["model_step"] = int(max(steps.values()))
+                self._reply(200 if healthy else 503, payload)
             elif self.path == "/v1/metrics":
                 snap = router.snapshot()
                 if wants_prometheus(self.headers.get("Accept")):
@@ -222,6 +234,9 @@ def _make_handler(router: FleetRouter):
                         f"slo_class must be 'interactive' or 'batch', "
                         f"got {slo_class!r}"
                     )
+                model_id = req.get("model_id")
+                if model_id is not None:
+                    model_id = str(model_id)
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(
                     400,
@@ -229,10 +244,17 @@ def _make_handler(router: FleetRouter):
                     trace_id=trace_id,
                 )
                 return
+
+            def _stamp(payload: dict) -> dict:
+                # The lane rides EVERY act response (tenancy contract),
+                # null in single-model mode.
+                return {**payload, "model_id": model_id}
+
             try:
                 future = router.submit(
                     obs, deterministic=deterministic, timeout_s=timeout_s,
                     trace_id=trace_id, slo_class=slo_class,
+                    model_id=model_id,
                 )
                 wait = (
                     timeout_s
@@ -246,41 +268,41 @@ def _make_handler(router: FleetRouter):
             except BackpressureError as e:
                 self._reply(
                     429,
-                    {
+                    _stamp({
                         "error": "backpressure",
                         "retry_after_s": e.retry_after_s,
-                    },
+                    }),
                     retry_after_s=e.retry_after_s,
                     trace_id=trace_id,
                 )
             except NoHealthyReplicas as e:
                 self._reply(
                     503,
-                    {"error": str(e)},
+                    _stamp({"error": str(e)}),
                     trace_id=trace_id,
                 )
             except (RequestTimeout, TimeoutError, FutureTimeoutError) as e:
                 self._reply(
                     504,
-                    {"error": f"deadline passed: {e}"},
+                    _stamp({"error": f"deadline passed: {e}"}),
                     trace_id=trace_id,
                 )
             except SchedulerStopped as e:
                 self._reply(
                     503,
-                    {"error": str(e)},
+                    _stamp({"error": str(e)}),
                     trace_id=trace_id,
                 )
             except ValueError as e:
                 self._reply(
                     400,
-                    {"error": f"bad request: {e}"},
+                    _stamp({"error": f"bad request: {e}"}),
                     trace_id=trace_id,
                 )
             except Exception as e:  # noqa: BLE001 — no tracebacks on the wire
                 self._reply(
                     500,
-                    {"error": type(e).__name__},
+                    _stamp({"error": type(e).__name__}),
                     trace_id=trace_id,
                 )
             else:
@@ -291,6 +313,9 @@ def _make_handler(router: FleetRouter):
                         "model_step": int(result.model_step),
                         "replica": int(result.replica),
                         "latency_s": round(result.latency_s, 6),
+                        # The lane that ANSWERED (scheduler-stamped) —
+                        # matches the request's lane by construction.
+                        "model_id": result.model_id,
                     },
                     trace_id=trace_id,
                 )

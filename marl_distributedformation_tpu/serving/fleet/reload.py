@@ -148,6 +148,12 @@ class FleetReloadCoordinator:
         released, gates reopened, a recorded ``load_errors`` entry —
         and every replica keeps serving the old step (never a partial
         swap); the next poll retries.
+      model_id: optional tenant lane (serving/tenancy): the coordinator
+        then watches ONE lane's ``promoted/`` directory and commits
+        into each replica's ``registries[model_id]`` cell, acquiring
+        only that lane's batch barriers — other lanes' dispatch groups
+        keep running through the whole commit, and ``fleet_step`` is
+        that lane's own monotonic step (per-model monotonicity).
     """
 
     def __init__(
@@ -157,9 +163,11 @@ class FleetReloadCoordinator:
         poll_interval_s: float = 2.0,
         max_recorded_errors: int = 32,
         commit_timeout_s: float = 30.0,
+        model_id: Optional[str] = None,
     ) -> None:
         self.log_dir = Path(log_dir)
         self.router = router
+        self.model_id = model_id
         self.poll_interval_s = poll_interval_s
         self.commit_timeout_s = commit_timeout_s
         self.swap_count = 0  # graftlock: guarded-by=_refresh_lock
@@ -187,15 +195,27 @@ class FleetReloadCoordinator:
         # The fleet step starts at the newest step any replica already
         # serves (the router seeds every replica identically).
         self._fleet_step = max(  # graftlock: guarded-by=_refresh_lock
-            r.registry.active_step for r in router.replicas
+            reg.active_step for reg in self._commit_registries()
         )
         self._refresh_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def _commit_registries(self) -> list:
+        """The registry cells this coordinator swaps — one per replica.
+        Single-model: each replica's primary ``registry``. Lane-keyed
+        (``model_id`` set): each replica's ``registries[model_id]``
+        cell, whose barrier gates only that lane's dispatch groups."""
+        if self.model_id is None:
+            return [r.registry for r in self.router.replicas]
+        return [
+            r.registries[self.model_id] for r in self.router.replicas
+        ]
+
     @property
     def fleet_step(self) -> int:
-        """The step every post-commit dispatch serves."""
+        """The step every post-commit dispatch serves (this lane's, when
+        the coordinator is lane-keyed)."""
         return self._fleet_step
 
     # -- reload ---------------------------------------------------------
@@ -268,10 +288,10 @@ class FleetReloadCoordinator:
         # plus pointer flips, never a weight transfer.
         with tracer.span("reload.stage", trace_id=trace_id, step=step):
             staged = [
-                (r, jax.device_put(restored, r.registry.device))
-                for r in self.router.replicas
+                (reg, jax.device_put(restored, reg.device))
+                for reg in self._commit_registries()
             ]
-        barriers = [r.registry.batch_lock for r, _ in staged]
+        barriers = [reg.batch_lock for reg, _ in staged]
         held = []
         installed = []
         wedged_replica = None
@@ -318,11 +338,11 @@ class FleetReloadCoordinator:
                 "reload.commit", trace_id=trace_id, step=step,
                 replicas=len(staged),
             ):
-                for r, params in staged:
-                    prev = r.registry.active()
+                for reg, params in staged:
+                    prev = reg.active()
                     fault_point("registry.swap")
-                    r.registry.install(params, step)
-                    installed.append((r, prev))
+                    reg.install(params, step)
+                    installed.append((reg, prev))
                 self._fleet_step = step
                 self.swap_count += 1
                 self.last_commit = {
@@ -330,6 +350,8 @@ class FleetReloadCoordinator:
                     "host_count": 1,
                     "step": step,
                 }
+                if self.model_id is not None:
+                    self.last_commit["model_id"] = self.model_id
         except Exception as e:  # noqa: BLE001 — contain + untear
             # A failure mid-commit (an injected fault, a broken
             # registry) must not leave a TORN swap: some replicas on
@@ -338,8 +360,8 @@ class FleetReloadCoordinator:
             # every installed replica back to its previous cell (all
             # locks are still held — the fleet never serves the torn
             # state), record, and keep serving the old step everywhere.
-            for r, (prev_params, prev_step) in reversed(installed):
-                r.registry.install(prev_params, prev_step)
+            for reg, (prev_params, prev_step) in reversed(installed):
+                reg.install(prev_params, prev_step)
             self.load_errors.append(
                 (
                     str(path),
@@ -394,7 +416,7 @@ class FleetReloadCoordinator:
                 f"checkpoint {path} was trained with policy {got!r}; "
                 f"this fleet serves {want!r}"
             )
-        template = {"params": self.router.replicas[0].registry.active()[0]}
+        template = {"params": self._commit_registries()[0].active()[0]}
         return restore_state_dict_partial(
             raw, template, origin=str(path)
         )["params"]
@@ -480,10 +502,10 @@ class FleetReloadCoordinator:
                 "reload.stage", trace_id=trace_id, step=step
             ):
                 staged = [
-                    (r, jax.device_put(restored, r.registry.device))
-                    for r in self.router.replicas
+                    (reg, jax.device_put(restored, reg.device))
+                    for reg in self._commit_registries()
                 ]
-            barriers = [r.registry.batch_lock for r, _ in staged]
+            barriers = [reg.batch_lock for reg, _ in staged]
             held = []
             wedged_replica = None
             try:
@@ -596,16 +618,16 @@ class FleetReloadCoordinator:
                 step=entry["step"],
                 replicas=len(entry["staged"]),
             ):
-                for r, params in entry["staged"]:
-                    prev = r.registry.active()
+                for reg, params in entry["staged"]:
+                    prev = reg.active()
                     fault_point("registry.swap")
-                    r.registry.install(params, entry["step"])
-                    installed.append((r, prev))
+                    reg.install(params, entry["step"])
+                    installed.append((reg, prev))
                 self._fleet_step = entry["step"]
                 self.swap_count += 1
         except Exception as e:  # noqa: BLE001 — contain + untear
-            for r, (prev_params, prev_step) in reversed(installed):
-                r.registry.install(prev_params, prev_step)
+            for reg, (prev_params, prev_step) in reversed(installed):
+                reg.install(prev_params, prev_step)
             self.load_errors.append(
                 (
                     str(entry["path"]),

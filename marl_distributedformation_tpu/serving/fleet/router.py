@@ -91,6 +91,11 @@ class Replica:
     broken_at: float = 0.0  # graftlock: guarded-by=_health_lock
     break_reason: str = ""  # graftlock: guarded-by=_health_lock
     kind: str = "replicated"
+    # Tenant lanes (serving/tenancy): one ``(params, step)`` cell PER
+    # model lane, each with its own batch barrier. ``registry`` then
+    # aliases the first lane's cell (legacy single-model readers); the
+    # lane-keyed reload coordinator commits into these directly.
+    registries: Optional[Dict[str, ReplicaRegistry]] = None
 
 
 class FleetRouter:
@@ -119,6 +124,19 @@ class FleetRouter:
         single-device replicas). A broken sharded replica fails its
         big requests over to the replicated ladder like any other
         circuit break.
+      lanes: optional ``model_id`` → ``(params, step)`` mapping — turns
+        every replica multi-tenant (serving/tenancy): each lane gets
+        its own device-resident ``ReplicaRegistry`` cell (own batch
+        barrier, own monotonic step) per replica, the scheduler runs in
+        tenant mode (per-lane admission queues + per-lane dispatch
+        barriers), and ``submit`` requires a ``model_id``. All lanes
+        share the ONE engine per replica — the params are traced
+        inputs, so same-architecture lanes reuse the same compiled rung
+        executables (``policy`` supplies the shared architecture; every
+        lane's params must match its tree). Not combinable with
+        ``sharded`` yet (docs/serving.md "Limits / next").
+      tenant_max_queue: per-lane admission bound in lanes mode
+        (default ``max_queue``, applied per lane).
     """
 
     def __init__(
@@ -138,6 +156,8 @@ class FleetRouter:
         logger: Any = None,
         emit_every: int = 200,
         sharded: Any = None,
+        lanes: Any = None,
+        tenant_max_queue: Optional[int] = None,
     ) -> None:
         import jax
 
@@ -147,7 +167,17 @@ class FleetRouter:
         n = len(devs) if num_replicas is None else int(num_replicas)
         if n < 1:
             raise ValueError(f"need at least one replica, got {n}")
+        if lanes is not None and sharded is not None:
+            raise ValueError(
+                "tenant lanes over the sharded big-rung slice are not "
+                "supported yet (docs/serving.md 'Limits / next')"
+            )
+        if lanes is not None and not lanes:
+            raise ValueError("lanes must declare at least one model lane")
         self.policy = policy
+        self.lane_ids: Tuple[str, ...] = (
+            tuple(lanes) if lanes is not None else ()
+        )
         self.default_timeout_s = default_timeout_s
         self.max_failovers = max_failovers
         self.probe_interval_s = probe_interval_s
@@ -159,21 +189,45 @@ class FleetRouter:
         self.replicas: List[Replica] = []
         for i in range(n):
             dev = devs[i % len(devs)]
-            registry = ReplicaRegistry(
-                jax.device_put(policy.params, dev),
-                step=initial_step,
-                device=dev,
-            )
             engine = BucketedPolicyEngine(
                 policy, buckets=buckets, seed=seed + i
             )
-            scheduler = MicroBatchScheduler(
-                engine,
-                registry=registry,
-                max_queue=max_queue,
-                window_ms=window_ms,
-                default_timeout_s=default_timeout_s,
-            )
+            if lanes is not None:
+                # One (params, step) cell per lane, all device-resident
+                # on THIS replica's device; the ONE engine serves every
+                # lane (params are traced inputs — same-arch lanes share
+                # its compiled rungs).
+                registries = {
+                    mid: ReplicaRegistry(
+                        jax.device_put(lane_params, dev),
+                        step=lane_step,
+                        device=dev,
+                    )
+                    for mid, (lane_params, lane_step) in lanes.items()
+                }
+                registry = registries[next(iter(registries))]
+                scheduler = MicroBatchScheduler(
+                    engine,
+                    registries=registries,
+                    max_queue=max_queue,
+                    tenant_max_queue=tenant_max_queue,
+                    window_ms=window_ms,
+                    default_timeout_s=default_timeout_s,
+                )
+            else:
+                registries = None
+                registry = ReplicaRegistry(
+                    jax.device_put(policy.params, dev),
+                    step=initial_step,
+                    device=dev,
+                )
+                scheduler = MicroBatchScheduler(
+                    engine,
+                    registry=registry,
+                    max_queue=max_queue,
+                    window_ms=window_ms,
+                    default_timeout_s=default_timeout_s,
+                )
             self.replicas.append(
                 Replica(
                     index=i,
@@ -181,6 +235,7 @@ class FleetRouter:
                     engine=engine,
                     scheduler=scheduler,
                     registry=registry,
+                    registries=registries,
                 )
             )
         self.sharded_replica: Optional[Replica] = None
@@ -275,11 +330,14 @@ class FleetRouter:
         on_result: Optional[Any] = None,
         trace_id: Optional[str] = None,
         slo_class: str = "interactive",
+        model_id: Optional[str] = None,
     ) -> Future:
         """Route one request; returns a future resolving to
         ``ServedResult`` (with ``.replica`` set). Raises
         :class:`BackpressureError` when every healthy replica is full,
         :class:`NoHealthyReplicas` when the whole fleet is broken.
+        ``model_id`` names the tenant lane (required in lanes mode —
+        the schedulers validate it against the declared lanes).
 
         ``on_result(result)``, if given, runs at resolution time INSIDE
         the serving replica's batch-barrier region — i.e. strictly
@@ -295,12 +353,14 @@ class FleetRouter:
         deadline = time.perf_counter() + timeout
         outer: Future = Future()
         replica, inner = self._route(
-            obs, deterministic, timeout_s, set(), trace_id, slo_class
+            obs, deterministic, timeout_s, set(), trace_id, slo_class,
+            model_id,
         )
         self._chain(
             replica, inner, outer, obs, deterministic, timeout_s,
             hops=0, tried={replica.index}, deadline=deadline,
             on_result=on_result, trace_id=trace_id, slo_class=slo_class,
+            model_id=model_id,
         )
         return outer
 
@@ -314,6 +374,7 @@ class FleetRouter:
         tried: Set[int],
         trace_id: Optional[str] = None,
         slo_class: str = "interactive",
+        model_id: Optional[str] = None,
     ) -> Tuple[Replica, Future]:
         """Submit to the best healthy replica not in ``tried``; walk down
         the drain-time ordering past individually-full replicas.
@@ -344,7 +405,10 @@ class FleetRouter:
                 for r in self.replicas
                 if r.healthy and r.index not in tried
             ),
-            key=lambda r: (_pref(r), r.scheduler.estimated_drain_s()),
+            key=lambda r: (
+                _pref(r),
+                r.scheduler.estimated_drain_s(model_id),
+            ),
         )
         rejections: List[BackpressureError] = []
         for r in candidates:
@@ -355,6 +419,7 @@ class FleetRouter:
                 inner = r.scheduler.submit(
                     obs, deterministic=deterministic, timeout_s=timeout_s,
                     trace_id=trace_id, slo_class=slo_class,
+                    model_id=model_id,
                 )
                 return r, inner
             except BackpressureError as e:
@@ -392,6 +457,7 @@ class FleetRouter:
         on_result: Optional[Any] = None,
         trace_id: Optional[str] = None,
         slo_class: str = "interactive",
+        model_id: Optional[str] = None,
     ) -> None:
         """Resolve ``outer`` from ``inner``, failing over replica faults
         onto a fresh replica while the hop budget and deadline allow."""
@@ -431,7 +497,7 @@ class FleetRouter:
                     try:
                         nxt, nfut = self._route(
                             obs, deterministic, timeout_s, tried,
-                            trace_id, slo_class,
+                            trace_id, slo_class, model_id,
                         )
                     except Exception as routing_exc:  # noqa: BLE001
                         outer.set_exception(routing_exc)
@@ -441,7 +507,7 @@ class FleetRouter:
                         nxt, nfut, outer, obs, deterministic, timeout_s,
                         hops + 1, tried | {nxt.index}, deadline,
                         on_result=on_result, trace_id=trace_id,
-                        slo_class=slo_class,
+                        slo_class=slo_class, model_id=model_id,
                     )
                     return
             outer.set_exception(exc)
@@ -521,12 +587,41 @@ class FleetRouter:
 
     def snapshot(self) -> Dict[str, float]:
         """Aggregated fleet metrics (fleet/metrics.py) plus the newest
-        step any replica serves."""
+        step any replica serves (in lanes mode: the newest step any
+        LANE serves, with per-lane ``model_{id}__step`` keys riding
+        along — obs/export.py folds them into one ``model``-labeled
+        family)."""
         snap = self.metrics.snapshot(self.replicas)
-        snap["model_step"] = float(
-            max(r.registry.active_step for r in self.replicas)
-        )
+        if self.lane_ids:
+            steps = self.lane_steps()
+            for mid, step in steps.items():
+                snap[f"model_{mid}__step"] = float(step)
+                snap[f"model_{mid}__queue_depth"] = float(
+                    sum(
+                        r.scheduler.lane_queue_depth(mid)
+                        for r in self.replicas
+                        if r.registries is not None
+                    )
+                )
+            snap["model_step"] = float(max(steps.values()))
+        else:
+            snap["model_step"] = float(
+                max(r.registry.active_step for r in self.replicas)
+            )
         return snap
+
+    def lane_steps(self) -> Dict[str, int]:
+        """Per-lane served step (lanes mode): the newest step any
+        replica's cell for that lane holds — each lane is monotonic
+        independently (per-model step monotonicity)."""
+        return {
+            mid: max(
+                r.registries[mid].active_step
+                for r in self.replicas
+                if r.registries is not None
+            )
+            for mid in self.lane_ids
+        }
 
     def compile_counts(self) -> Dict[int, Dict[int, int]]:
         """Per-replica per-rung trace counts — the fleet-wide

@@ -115,6 +115,10 @@ class MeshCoordinator:
         unreachable host at commit time is marked dead (it serves
         nothing), the round still lands on the others.
       host/port: the RPC bind address (``port=0`` = ephemeral).
+      model_id: optional tenant lane (serving/tenancy) this
+        coordinator's watched directory promotes — stamped into
+        ``last_commit`` so the promotion log's mesh attribution
+        (schema 5) names the lane a global swap landed for.
     """
 
     def __init__(
@@ -129,8 +133,10 @@ class MeshCoordinator:
         max_recorded_errors: int = 32,
         host: str = "127.0.0.1",
         port: int = 0,
+        model_id: Optional[str] = None,
     ) -> None:
         self.log_dir = Path(log_dir) if log_dir is not None else None
+        self.model_id = model_id
         self.lease_s = float(lease_s)
         self.dead_after_s = float(dead_after_s)
         self.prepare_timeout_s = float(prepare_timeout_s)
@@ -644,6 +650,8 @@ class MeshCoordinator:
             "host_count": committed,
             "step": step,
         }
+        if self.model_id is not None:
+            self.last_commit["model_id"] = self.model_id
         swap_s = time.perf_counter() - t0
         registry.counter("mesh_global_swaps_total").inc()
         registry.gauge("mesh_step").set(step)

@@ -54,6 +54,10 @@ class ModelRegistry:
         ``act_dim`` forwarded to ``LoadedPolicy.from_checkpoint``).
       poll_interval_s: cadence of the background watcher thread
         (``start()``); ``refresh()`` may also be called directly.
+      model_id: optional tenant-lane name (serving/tenancy): purely an
+        identity stamp here — the single-engine registry still serves
+        one model; the fleet's lane-keyed ``ReplicaRegistry`` cells are
+        where multi-model state lives.
     """
 
     def __init__(
@@ -64,10 +68,12 @@ class ModelRegistry:
         act_dim: int = 2,
         poll_interval_s: float = 2.0,
         max_recorded_errors: int = 32,
+        model_id: Optional[str] = None,
     ) -> None:
         import jax
 
         self.log_dir = Path(log_dir)
+        self.model_id = model_id
         if policy is None:
             path = latest_checkpoint(self.log_dir)
             if path is None:

@@ -37,6 +37,20 @@ requests are queued — the newest-queued batch request is *preempted*
 contract as a door reject, which the client retry loop already honors)
 and the interactive request takes its slot. With all-default traffic
 the queue is plain FIFO — the classes cost nothing until used.
+
+**Tenant lanes.** Constructed with ``registries`` (a ``model_id`` →
+registry mapping — serving/tenancy builds it), the scheduler multiplexes
+NAMED MODEL LANES over the one engine: every request carries a
+``model_id``, admission is a separate bounded two-class queue PER LANE
+(one tenant's batch storm fills only its own lane — others admit
+untouched, and preemption never crosses a lane), backpressure is priced
+per lane, dispatch drains lanes round-robin with interactive-anywhere
+ahead of batch-anywhere, and each dispatch group snapshots ITS lane's
+``(params, step)`` and runs under ITS lane's batch barrier — so a
+reload coordinator committing one lane quiesces only that lane's
+groups while every other lane keeps dispatching. The params ride
+``engine.act(nn_params=...)`` as traced inputs, so same-architecture
+lanes share the engine's compiled rung executables.
 """
 
 from __future__ import annotations
@@ -89,6 +103,7 @@ class ServedResult:
     model_step: int  # checkpoint step of the params that answered
     latency_s: float  # enqueue -> result
     replica: int = -1  # fleet replica index (-1: single-engine serving)
+    model_id: Optional[str] = None  # tenant lane (None: single-model)
 
 
 @dataclasses.dataclass
@@ -100,6 +115,7 @@ class _Request:
     timeout_s: Optional[float]
     trace_id: Optional[str] = None
     slo_class: str = SLO_INTERACTIVE
+    model_id: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.timeout_s is not None and (
@@ -190,6 +206,106 @@ class _ClassedQueue:
             return req
 
 
+class _TenantAdmission:
+    """Per-tenant bounded admission: one two-class queue per model lane.
+
+    The same ``put_nowait`` / ``get`` / ``get_nowait`` / ``qsize``
+    surface as :class:`_ClassedQueue`, with the isolation contract
+    inside:
+
+    - **Bounds are per lane.** A tenant filling its own ``maxsize``
+      admission budget gets ``queue.Full`` (→ per-tenant backpressure);
+      every other lane's budget is untouched — a 512-rung batch storm on
+      one lane cannot consume another lane's slots.
+    - **Preemption stays within a lane.** A full lane's interactive
+      arrival preempts the newest BATCH request of the SAME lane only;
+      another tenant's batch work is never evicted for this tenant's
+      interactive traffic.
+    - **Draining is round-robin across lanes**, interactive-anywhere
+      ahead of batch-anywhere: lane B's interactive request dispatches
+      before lane A's batch backlog no matter how deep A's queue is,
+      and equal-class lanes take turns instead of starving on arrival
+      order.
+    """
+
+    def __init__(self, lanes: Any, maxsize: int) -> None:
+        self._maxsize = maxsize  # per-lane admission bound
+        self._cond = threading.Condition()
+        # lane -> (interactive deque, batch deque), draining order fixed
+        # at construction (the directory's lane order).
+        self._lanes = {  # graftlock: guarded-by=_cond
+            mid: (deque(), deque()) for mid in lanes
+        }
+        self._order = list(self._lanes)
+        self._rr = 0  # graftlock: guarded-by=_cond
+
+    def qsize(self) -> int:
+        with self._cond:
+            return sum(
+                len(i) + len(b) for i, b in self._lanes.values()
+            )
+
+    def lane_depth(self, model_id: str) -> int:
+        with self._cond:
+            i, b = self._lanes[model_id]
+            return len(i) + len(b)
+
+    def put_nowait(self, req: _Request) -> Optional[_Request]:
+        """Admit ``req`` into its lane; returns a preempted same-lane
+        batch request (fail its future) or None. ``queue.Full`` when the
+        LANE's budget is exhausted — per-tenant backpressure."""
+        with self._cond:
+            interactive, batch = self._lanes[req.model_id]
+            depth = len(interactive) + len(batch)
+            lane = batch if req.slo_class == SLO_BATCH else interactive
+            if depth < self._maxsize:
+                lane.append(req)
+                self._cond.notify()
+                return None
+            if req.slo_class != SLO_BATCH and batch:
+                evicted = batch.pop()
+                interactive.append(req)
+                self._cond.notify()
+                return evicted
+            raise queue.Full
+
+    # graftlock: holds=_cond
+    def _pop(self) -> Optional[_Request]:
+        n = len(self._order)
+        for cls_idx in (0, 1):  # 0: interactive pass, 1: batch pass
+            for k in range(n):
+                mid = self._order[(self._rr + k) % n]
+                dq = self._lanes[mid][cls_idx]
+                if dq:
+                    self._rr = (self._rr + k + 1) % n
+                    return dq.popleft()
+        return None
+
+    def get(self, timeout: Optional[float] = None) -> _Request:
+        deadline = (
+            None if timeout is None else time.perf_counter() + timeout
+        )
+        with self._cond:
+            while True:
+                req = self._pop()
+                if req is not None:
+                    return req
+                if deadline is None:
+                    self._cond.wait()
+                    continue
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    raise queue.Empty
+                self._cond.wait(remaining)
+
+    def get_nowait(self) -> _Request:
+        with self._cond:
+            req = self._pop()
+            if req is None:
+                raise queue.Empty
+            return req
+
+
 class MicroBatchScheduler:
     """Deadline-window micro-batching over a :class:`BucketedPolicyEngine`.
 
@@ -203,6 +319,14 @@ class MicroBatchScheduler:
       default_timeout_s: per-request deadline when ``submit`` gets none.
       logger: optional ``utils.logging.MetricsLogger``; a metrics record
         is emitted every ``emit_every`` batches.
+      registries: optional ``model_id`` → registry mapping — turns the
+        scheduler multi-tenant (module docstring "Tenant lanes"): every
+        ``submit`` must then carry a known ``model_id``, admission is a
+        per-lane bounded queue, and each dispatch group runs under its
+        lane's batch barrier with its lane's params. Mutually exclusive
+        with ``registry``.
+      tenant_max_queue: per-lane admission bound in tenant mode
+        (default: ``max_queue``, applied per lane).
     """
 
     def __init__(
@@ -215,15 +339,30 @@ class MicroBatchScheduler:
         metrics: Optional[ServingMetrics] = None,
         logger: Any = None,
         emit_every: int = 100,
+        registries: Any = None,
+        tenant_max_queue: Optional[int] = None,
     ) -> None:
+        if registries is not None and registry is not None:
+            raise ValueError(
+                "pass either registry (single-model) or registries "
+                "(tenant lanes), not both"
+            )
         self.engine = engine
         self.registry = registry
+        self.registries = registries
         self.window_s = window_ms / 1e3
         self.default_timeout_s = default_timeout_s
         self.metrics = metrics or ServingMetrics()
         self.logger = logger
         self.emit_every = emit_every
-        self._queue = _ClassedQueue(maxsize=max_queue)
+        if registries is not None:
+            if not registries:
+                raise ValueError("registries must declare at least one lane")
+            self._queue: Any = _TenantAdmission(
+                registries, maxsize=tenant_max_queue or max_queue
+            )
+        else:
+            self._queue = _ClassedQueue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._busy = False  # worker mid-dispatch (drain estimation)
@@ -237,6 +376,7 @@ class MicroBatchScheduler:
         timeout_s: Optional[float] = None,
         trace_id: Optional[str] = None,
         slo_class: str = SLO_INTERACTIVE,
+        model_id: Optional[str] = None,
     ) -> Future:
         """Enqueue one request of ``(n, *row_shape)`` observation rows.
         Returns a future resolving to :class:`ServedResult`. Raises
@@ -244,12 +384,31 @@ class MicroBatchScheduler:
         rides the request to the dispatch batch span (obs/) so one ID
         correlates a request across frontend, router, and batch.
         ``slo_class`` is the admission class (module docstring): batch
-        requests yield to interactive ones under backpressure."""
+        requests yield to interactive ones under backpressure.
+        ``model_id`` names the tenant lane — required (and validated
+        against the declared lanes) in tenant mode, rejected in
+        single-model mode."""
         if self._thread is None:
             raise RuntimeError("scheduler not started (use start() / with)")
         if slo_class not in SLO_CLASSES:
             raise ValueError(
                 f"unknown slo_class {slo_class!r}; known: {SLO_CLASSES}"
+            )
+        if self.registries is not None:
+            if model_id is None:
+                raise ValueError(
+                    "this scheduler serves tenant lanes: submit requires "
+                    f"model_id (known: {sorted(self.registries)})"
+                )
+            if model_id not in self.registries:
+                raise ValueError(
+                    f"unknown model_id {model_id!r}; known lanes: "
+                    f"{sorted(self.registries)}"
+                )
+        elif model_id is not None:
+            raise ValueError(
+                "this scheduler serves a single model; model_id "
+                f"{model_id!r} names a lane it does not have"
             )
         obs = np.asarray(obs, np.float32)
         if obs.ndim < 2 or obs.shape[0] < 1:
@@ -266,21 +425,23 @@ class MicroBatchScheduler:
             ),
             trace_id=trace_id,
             slo_class=slo_class,
+            model_id=model_id,
         )
         try:
             preempted = self._queue.put_nowait(req)
         except queue.Full:
             self.metrics.record_reject()
-            raise BackpressureError(self.retry_after_s()) from None
+            raise BackpressureError(self.retry_after_s(model_id)) from None
         if preempted is not None:
             # A queued batch request yielded its slot to this
             # interactive arrival: same reject-with-retry-after
             # contract as a door reject — the client's existing retry
-            # loop re-submits it once pressure eases.
+            # loop re-submits it once pressure eases. In tenant mode
+            # the preempted request is by construction the SAME lane's.
             self.metrics.record_preempted()
             if not preempted.future.done():
                 preempted.future.set_exception(
-                    BackpressureError(self.retry_after_s())
+                    BackpressureError(self.retry_after_s(model_id))
                 )
         if self._stop.is_set():
             # stop() may have drained the queue between our liveness
@@ -291,22 +452,34 @@ class MicroBatchScheduler:
         self.metrics.record_submit(self._queue.qsize())
         return req.future
 
-    def retry_after_s(self) -> float:
+    def retry_after_s(self, model_id: Optional[str] = None) -> float:
         """Backoff hint: the window plus roughly how long the current
-        backlog takes to drain at the recent batch rate."""
-        return self.window_s + self.estimated_drain_s()
+        backlog takes to drain at the recent batch rate. With a
+        ``model_id`` (tenant mode) the backlog is THAT lane's — one
+        lane's storm prices its own retries, not its neighbors'."""
+        return self.window_s + self.estimated_drain_s(model_id)
 
-    def estimated_drain_s(self) -> float:
+    def estimated_drain_s(self, model_id: Optional[str] = None) -> float:
         """Roughly how long the current backlog takes to drain at the
         recent batch rate — the number a fleet router routes on. The
         in-flight batch counts: a worker stuck in a slow dispatch with
         an empty queue is NOT an idle replica."""
-        backlog = self._queue.qsize() + (1 if self._busy else 0)
+        if model_id is not None and self.registries is not None:
+            depth = self._queue.lane_depth(model_id)
+        else:
+            depth = self._queue.qsize()
+        backlog = depth + (1 if self._busy else 0)
         return backlog * self.metrics.mean_batch_seconds()
 
     @property
     def queue_depth(self) -> int:
         return self._queue.qsize()
+
+    def lane_queue_depth(self, model_id: str) -> int:
+        """Queued requests in one tenant lane (tenant mode only)."""
+        if self.registries is None:
+            raise ValueError("single-model scheduler has no tenant lanes")
+        return self._queue.lane_depth(model_id)
 
     @property
     def alive(self) -> bool:
@@ -450,13 +623,28 @@ class MicroBatchScheduler:
                 live.append(req)
         if expired:
             self.metrics.record_timeout(expired)
-        # Group by (deterministic, row shape): ``deterministic`` is
-        # per-batch (one traced scalar), and rows of different trailing
-        # shapes cannot share a concatenated buffer — one client sending
-        # odd-shaped observations must never fail another's request.
+        # Group by (model lane, deterministic, row shape):
+        # ``deterministic`` is per-batch (one traced scalar), rows of
+        # different trailing shapes cannot share a concatenated buffer,
+        # and different lanes answer with different params — one client
+        # sending odd-shaped observations must never fail another's
+        # request, and one tenant's rows must never meet another's
+        # weights.
         groups: dict = {}
         for r in live:
-            groups.setdefault((r.deterministic, r.obs.shape[1:]), []).append(r)
+            groups.setdefault(
+                (r.model_id, r.deterministic, r.obs.shape[1:]), []
+            ).append(r)
+        if self.registries is not None:
+            # Per-lane barriers: each group runs under ITS lane's
+            # barrier only, so a coordinator committing one lane's swap
+            # waits out that lane's in-flight groups while every other
+            # lane's groups keep dispatching — per-model step
+            # monotonicity without a fleet-wide pause.
+            for (mid, flag, _), group in groups.items():
+                with self.registries[mid].batch_lock:
+                    self._dispatch_group(group, flag, model_id=mid)
+            return
         # Batch barrier: a registry may expose ``batch_lock`` (the fleet
         # replica registry does), held for the whole dispatch. A reload
         # coordinator that acquires EVERY replica's lock before flipping
@@ -464,12 +652,22 @@ class MicroBatchScheduler:
         # in flight — the foundation of globally step-monotonic swaps.
         lock = getattr(self.registry, "batch_lock", None)
         with lock if lock is not None else contextlib.nullcontext():
-            for (flag, _), group in groups.items():
+            for (_, flag, _), group in groups.items():
                 self._dispatch_group(group, flag)
 
-    def _dispatch_group(self, group: List[_Request], flag: bool) -> None:
-        if self.registry is not None:
-            nn_params, step = self.registry.active()
+    def _dispatch_group(
+        self,
+        group: List[_Request],
+        flag: bool,
+        model_id: Optional[str] = None,
+    ) -> None:
+        registry = (
+            self.registries[model_id]
+            if self.registries is not None
+            else self.registry
+        )
+        if registry is not None:
+            nn_params, step = registry.active()
         else:
             nn_params, step = None, 0
         sizes = [r.obs.shape[0] for r in group]
@@ -501,6 +699,7 @@ class MicroBatchScheduler:
                 rows=sum(sizes),
                 requests=len(group),
                 model_step=int(step),
+                model_id=model_id,
                 trace_ids=[r.trace_id for r in group if r.trace_id],
             )
         latencies = []
@@ -513,6 +712,7 @@ class MicroBatchScheduler:
                     actions=actions[offset : offset + n],
                     model_step=step,
                     latency_s=latency,
+                    model_id=model_id,
                 )
             )
             offset += n
@@ -530,6 +730,6 @@ class MicroBatchScheduler:
         ):
             record = self.metrics.snapshot()
             record["model_step"] = float(step)
-            if self.registry is not None:
-                record["model_swap_count"] = float(self.registry.swap_count)
+            if registry is not None:
+                record["model_swap_count"] = float(registry.swap_count)
             self.logger.log(record, step=self.metrics.batches_total)

@@ -1,0 +1,28 @@
+"""Multi-tenant serving: named model lanes over one fleet.
+
+``TenantDirectory`` declares the lanes, ``TenantFleet`` serves them —
+same-arch lanes share compiled rung executables (params are traced
+inputs), every lane gets its own admission queue, its own reload
+coordinator, and its own monotonic step. See docs/serving.md
+"Multi-tenant lanes".
+"""
+
+from marl_distributedformation_tpu.serving.tenancy.directory import (
+    TenantDirectory,
+    TenantSpec,
+)
+from marl_distributedformation_tpu.serving.tenancy.fleet import (
+    TenantFleet,
+    tenant_fleet_from_directory,
+)
+from marl_distributedformation_tpu.serving.tenancy.smoke import (
+    run_tenant_smoke,
+)
+
+__all__ = [
+    "TenantDirectory",
+    "TenantSpec",
+    "TenantFleet",
+    "tenant_fleet_from_directory",
+    "run_tenant_smoke",
+]

@@ -1,0 +1,176 @@
+"""TenantDirectory: the declared set of named model lanes.
+
+One fleet, many models. A :class:`TenantSpec` names a lane — which
+environment its policies act in, which architecture they are, what SLO
+class its traffic defaults to, and which ``promoted/`` directory its
+always-learning pipeline publishes into. The :class:`TenantDirectory`
+is the fail-fast registry over those lanes (the same did-you-mean
+discipline as ``envs.get_env``) plus the ARCH GROUPING the fleet builds
+from: lanes whose ``(policy, hidden, obs_dim, act_dim)`` signature
+matches share one set of compiled rung executables — their params are
+traced inputs — while distinct architectures get their own engines and
+their own budget-1 compile receipts.
+
+Lane names become Prometheus label values and ``model_{id}__{metric}``
+snapshot keys (obs/export.py folds on the FIRST double underscore), so
+``model_id`` is restricted to ``[A-Za-z0-9_.-]`` without a ``__`` run —
+the grammar stays unambiguous no matter the name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import difflib
+import re
+from pathlib import Path
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from marl_distributedformation_tpu.serving.scheduler import SLO_CLASSES
+
+_MODEL_ID_OK = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One named model lane.
+
+    Args:
+      model_id: the lane's name — rides requests, responses, promotion
+        log lines (schema 5), and the ``model`` Prometheus label.
+      env: environment the lane's policies act in (``envs`` registry
+        name); decides the observation row shape and therefore the
+        architecture group.
+      policy: policy architecture class name (``compat.policy``
+        registry: MLPActorCritic / CTDEActorCritic / GNNActorCritic).
+      hidden: the architecture's hidden-layer widths (part of the arch
+        signature — two MLPs of different widths do NOT share
+        executables).
+      slo_class: default admission class for this lane's traffic when a
+        request does not say ("interactive" or "batch").
+      promoted_dir: the lane's always-learning ``promoted/`` directory;
+        its lane-keyed reload coordinator watches this. ``None`` = a
+        static lane (seeded once, never hot-swapped).
+      num_agents: optional env override (changes ``obs_dim`` and hence
+        the arch group).
+      act_dim: action dimensionality.
+      max_queue: optional per-lane admission bound override (default:
+        the fleet's ``tenant_max_queue``).
+    """
+
+    model_id: str
+    env: str = "formation"
+    policy: str = "MLPActorCritic"
+    hidden: Tuple[int, ...] = (64, 64)
+    slo_class: str = "interactive"
+    promoted_dir: Optional[Path] = None
+    num_agents: Optional[int] = None
+    act_dim: int = 2
+    max_queue: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if not _MODEL_ID_OK.match(self.model_id) or "__" in self.model_id:
+            raise ValueError(
+                f"bad model_id {self.model_id!r}: must match "
+                f"{_MODEL_ID_OK.pattern} with no '__' (it becomes a "
+                "metric label and a model_{id}__{metric} snapshot key)"
+            )
+        if self.slo_class not in SLO_CLASSES:
+            raise ValueError(
+                f"lane {self.model_id!r}: unknown slo_class "
+                f"{self.slo_class!r}; known: {SLO_CLASSES}"
+            )
+        from marl_distributedformation_tpu.compat.policy import (
+            POLICY_REGISTRY,
+        )
+
+        if self.policy not in POLICY_REGISTRY:
+            raise ValueError(
+                f"lane {self.model_id!r}: unknown policy {self.policy!r}; "
+                f"known: {sorted(POLICY_REGISTRY)}"
+            )
+        object.__setattr__(self, "hidden", tuple(self.hidden))
+        if self.promoted_dir is not None:
+            object.__setattr__(
+                self, "promoted_dir", Path(self.promoted_dir)
+            )
+        # Fail fast on a misspelled env name at DECLARATION time (the
+        # registry's did-you-mean error), not at first request.
+        self.env_params()
+
+    def env_params(self) -> Any:
+        """The lane's environment params (the env registry's defaults
+        with this lane's overrides) — what the fleet builder hands to
+        ``LoadedPolicy.from_checkpoint``."""
+        from marl_distributedformation_tpu import envs
+
+        overrides = (
+            {} if self.num_agents is None
+            else {"num_agents": self.num_agents}
+        )
+        return envs.get_env(self.env).default_params(**overrides)
+
+    @property
+    def obs_dim(self) -> int:
+        return int(self.env_params().obs_dim)
+
+    def arch_key(self) -> str:
+        """The executable-sharing signature: lanes with equal keys serve
+        through ONE engine per replica (shared compiled rungs); distinct
+        keys get their own engines and budget-1 receipts."""
+        widths = "x".join(str(w) for w in self.hidden)
+        return (
+            f"{self.policy}_h{widths}_obs{self.obs_dim}"
+            f"_act{self.act_dim}"
+        )
+
+
+class TenantDirectory:
+    """Ordered, fail-fast registry of :class:`TenantSpec` lanes."""
+
+    def __init__(self, specs: Iterable[TenantSpec] = ()) -> None:
+        self._lanes: Dict[str, TenantSpec] = {}
+        for spec in specs:
+            self.add(spec)
+
+    def add(self, spec: TenantSpec) -> TenantSpec:
+        if spec.model_id in self._lanes:
+            raise ValueError(
+                f"duplicate model_id {spec.model_id!r} in directory"
+            )
+        self._lanes[spec.model_id] = spec
+        return spec
+
+    def get(self, model_id: str) -> TenantSpec:
+        """Fail-fast lookup with a did-you-mean hint — the same
+        contract as ``envs.get_env``."""
+        try:
+            return self._lanes[model_id]
+        except KeyError:
+            close = difflib.get_close_matches(
+                str(model_id), list(self._lanes), n=1
+            )
+            hint = f" (did you mean {close[0]!r}?)" if close else ""
+            raise KeyError(
+                f"unknown model_id {model_id!r}{hint}; declared lanes: "
+                f"{sorted(self._lanes)}"
+            ) from None
+
+    def lanes(self) -> Tuple[TenantSpec, ...]:
+        return tuple(self._lanes.values())
+
+    def arch_groups(self) -> Dict[str, List[TenantSpec]]:
+        """Lanes grouped by executable-sharing signature, declaration
+        order preserved within each group."""
+        groups: Dict[str, List[TenantSpec]] = {}
+        for spec in self._lanes.values():
+            groups.setdefault(spec.arch_key(), []).append(spec)
+        return groups
+
+    def __contains__(self, model_id: object) -> bool:
+        return model_id in self._lanes
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._lanes)
+
+    def __len__(self) -> int:
+        return len(self._lanes)

@@ -808,6 +808,98 @@ def _envs_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _tenancy_problems(rec: dict) -> list[str]:
+    """Structural validation of the multi-tenant serving fields
+    (serving/tenancy, bench tenant smoke), whenever present:
+
+    - ``tenant_isolation_p95_ratio`` a finite number >= 1 wherever a
+      quiet lane's storm-phase p95 is floored at its own baseline (a
+      sub-1 or non-finite ratio means the two phases were not actually
+      measured), recorded beside at least one per-tenant rate;
+    - every ``model_{id}__requests_per_sec`` a finite number > 0 — a
+      lane with zero throughput during the storm never actually served;
+    - ``shared_rung_compiles`` a non-empty ``{"{arch}:rung{B}": n}``
+      dict with every count EXACTLY 1: same-arch lanes must share one
+      compile per (arch, rung) and each distinct arch must pay exactly
+      its own budget-1 compile — 0 means the rung was never warmed,
+      2+ means a lane retraced;
+    - per-lane ``model_{id}__step_monotonic_violations`` exactly 0.
+
+    ``"skipped"`` sentinels are honored as structurally absent."""
+    problems = []
+    ratio = _present(rec, "tenant_isolation_p95_ratio")
+    if ratio is not None:
+        try:
+            v = float(ratio)
+            if not math.isfinite(v) or v < 1.0:
+                problems.append(
+                    f"tenant_isolation_p95_ratio={ratio!r} (need a "
+                    "finite number >= 1: the quiet lane's storm-phase "
+                    "p95 is floored at its own baseline)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"tenant_isolation_p95_ratio is not a number: {ratio!r}"
+            )
+        rate_keys = [
+            k for k in rec
+            if k.startswith("model_") and k.endswith("__requests_per_sec")
+        ]
+        if not rate_keys:
+            problems.append(
+                "tenant_isolation_p95_ratio recorded without any "
+                "model_{id}__requests_per_sec lane rates beside it"
+            )
+    for key in sorted(rec):
+        if not key.startswith("model_"):
+            continue
+        v = _present(rec, key)
+        if v is None:
+            continue
+        if key.endswith("__requests_per_sec"):
+            try:
+                f = float(v)
+                if not math.isfinite(f) or f <= 0.0:
+                    problems.append(
+                        f"{key}={v!r} (need a finite number > 0 — a "
+                        "zero-rate lane never actually served)"
+                    )
+            except (TypeError, ValueError):
+                problems.append(f"{key} is not a number: {v!r}")
+        elif key.endswith("__step_monotonic_violations"):
+            try:
+                if int(float(v)) != 0:
+                    problems.append(
+                        f"{key}={v!r} — a lane's model_step went "
+                        "backward in response completion order; "
+                        "per-model monotonicity is broken"
+                    )
+            except (TypeError, ValueError):
+                problems.append(f"{key} is not an int: {v!r}")
+    shared = _present(rec, "shared_rung_compiles")
+    if shared is not None:
+        if not isinstance(shared, dict) or not shared:
+            problems.append(
+                "shared_rung_compiles must be a non-empty dict of "
+                f"'{{arch}}:rung{{B}}' -> compile count: {shared!r}"
+            )
+        else:
+            for rung_key in sorted(shared):
+                count = shared[rung_key]
+                try:
+                    bad = int(count) != 1
+                except (TypeError, ValueError):
+                    bad = True
+                if bad:
+                    problems.append(
+                        f"shared_rung_compiles[{rung_key!r}]={count!r} "
+                        "— every (arch, rung) must compile exactly "
+                        "once (0 = never warmed, 2+ = a lane retraced "
+                        "instead of sharing the executable)"
+                    )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -832,6 +924,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_lint_problems(rec))
     problems.extend(_sebulba_problems(rec))
     problems.extend(_envs_problems(rec))
+    problems.extend(_tenancy_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(

@@ -23,6 +23,13 @@ Usage:
         python scripts/serve_policy.py --init-policy MLPActorCritic \\
         --obs-dim 8 --fleet --replicas 2 --smoke
 
+    # multi-tenant: named model lanes over ONE fleet, each lane hot-
+    # reloading from its own promoted/ dir; the smoke drives every lane
+    # and reports per-tenant throughput + step monotonicity
+    python scripts/serve_policy.py --fleet \\
+        --tenants formation-a=logs/a/promoted,formation-b=logs/b/promoted \\
+        --smoke
+
 The server is the in-process stack from
 ``marl_distributedformation_tpu.serving`` (bucketed compiled engine,
 micro-batching scheduler, hot-reload registry — docs/serving.md); this
@@ -30,7 +37,9 @@ CLI wires it to a checkpoint directory and drives it with a synthetic
 mixed-size load (``--smoke``) or leaves it serving + watching
 (``--watch``, the mode a real frontend would embed). ``--fleet``
 replaces the single engine with ``serving.fleet`` (router + coordinated
-reload + optional HTTP frontend, docs/serving.md "Fleet").
+reload + optional HTTP frontend, docs/serving.md "Fleet");
+``--tenants`` replaces the single model with named lanes over that one
+fleet (``serving.tenancy``, docs/serving.md "Multi-tenant lanes").
 """
 
 from __future__ import annotations
@@ -530,6 +539,150 @@ def _run_fleet(args) -> int:
     return 0
 
 
+def _parse_tenants(chunks) -> list:
+    """``NAME=DIR`` pairs from repeated/comma-joined --tenants values."""
+    lanes = []
+    seen = set()
+    for chunk in chunks:
+        for item in chunk.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            name, sep, directory = item.partition("=")
+            if not sep or not name or not directory:
+                raise SystemExit(
+                    f"--tenants wants NAME=DIR pairs, got {item!r}"
+                )
+            if name in seen:
+                raise SystemExit(f"--tenants declares {name!r} twice")
+            seen.add(name)
+            lanes.append((name, directory))
+    if not lanes:
+        raise SystemExit("--tenants got no NAME=DIR pairs")
+    return lanes
+
+
+def _run_tenants(args) -> int:
+    """The --tenants serving path: named model lanes over ONE fleet
+    (serving/tenancy/, docs/serving.md "Multi-tenant lanes"). Each
+    lane's architecture is read from its own newest checkpoint, so
+    same-arch lanes land in one router group (shared compiled rungs)
+    and distinct archs get their own — the smoke's
+    ``shared_rung_compiles`` census is the receipt."""
+    if args.replicas:
+        _ensure_cpu_devices(args.replicas)
+
+    from marl_distributedformation_tpu.compat.policy import (
+        infer_hidden,
+        load_checkpoint_raw,
+    )
+    from marl_distributedformation_tpu.serving.tenancy import (
+        TenantDirectory,
+        TenantSpec,
+        run_tenant_smoke,
+        tenant_fleet_from_directory,
+    )
+    from marl_distributedformation_tpu.utils.checkpoint import (
+        latest_checkpoint,
+    )
+
+    pairs = _parse_tenants(args.tenants)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    directory = TenantDirectory()
+    for name, lane_dir in pairs:
+        path = latest_checkpoint(Path(lane_dir))
+        if path is None:
+            raise SystemExit(
+                f"--tenants {name}={lane_dir}: no rl_model_*_steps"
+                ".msgpack checkpoint there to serve"
+            )
+        raw = load_checkpoint_raw(path)
+        policy_cls = raw.get("policy", "MLPActorCritic")
+        hidden = infer_hidden(raw["params"]["params"], policy_cls)
+        try:
+            directory.add(
+                TenantSpec(
+                    model_id=name,
+                    policy=policy_cls,
+                    hidden=tuple(hidden) if hidden else (64, 64),
+                    promoted_dir=str(lane_dir),
+                    num_agents=args.agents,
+                )
+            )
+        except ValueError as e:
+            raise SystemExit(f"--tenants {name}: {e}") from e
+
+    fleet = tenant_fleet_from_directory(
+        directory,
+        poll_interval_s=args.poll_s,
+        num_replicas=args.replicas,
+        buckets=buckets,
+        window_ms=args.window_ms,
+        max_queue=args.queue,
+        watch=True,
+    )
+    groups = directory.arch_groups()
+    print(
+        f"[serve] tenant fleet: {len(directory)} lanes in "
+        f"{len(groups)} arch group(s) — "
+        + "; ".join(
+            f"{arch}: {', '.join(s.model_id for s in specs)}"
+            for arch, specs in groups.items()
+        ),
+        file=sys.stderr,
+    )
+    try:
+        fleet.start()
+        if args.smoke or not args.watch:
+            report = run_tenant_smoke(
+                fleet,
+                duration_s=args.duration,
+                clients_per_lane=max(1, args.clients // len(pairs)),
+                deterministic=not args.stochastic,
+            )
+            report["buckets"] = ",".join(str(b) for b in buckets)
+            print(json.dumps(report), flush=True)
+            starved = [
+                name
+                for name, _ in pairs
+                if report[f"model_{name}__requests_ok"] == 0
+            ]
+            wiggled = [
+                name
+                for name, _ in pairs
+                if report[f"model_{name}__step_monotonic_violations"] > 0
+            ]
+            if starved or wiggled:
+                print(
+                    f"[serve] tenant smoke failing — lanes served 0: "
+                    f"{starved}; lanes non-monotonic: {wiggled}",
+                    file=sys.stderr,
+                )
+                return 1
+        else:
+            print(
+                "[serve] tenant fleet serving; Ctrl-C to stop",
+                file=sys.stderr,
+            )
+            while True:
+                time.sleep(10.0)
+                steps = fleet.lane_steps()
+                print(
+                    "[serve] "
+                    + " ".join(
+                        f"{mid}@{step}" for mid, step in sorted(steps.items())
+                    )
+                    + f" healthy={fleet.healthy_replicas}/"
+                    f"{len(fleet.replicas)}",
+                    file=sys.stderr,
+                )
+    except KeyboardInterrupt:
+        print("[serve] interrupted; shutting down", file=sys.stderr)
+    finally:
+        fleet.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -613,6 +766,15 @@ def main(argv=None) -> int:
         type=int,
         help="fleet replica count (default: one per local device); on a "
         "CPU backend the device pool is widened to match if needed",
+    )
+    parser.add_argument(
+        "--tenants",
+        action="append",
+        metavar="NAME=DIR",
+        help="with --fleet: serve named model lanes over ONE fleet, "
+        "each NAME hot-reloading from its own promoted checkpoint DIR "
+        "(repeat the flag or comma-join pairs); the smoke drives every "
+        "lane and reports per-tenant req/s + step monotonicity",
     )
     parser.add_argument(
         "--port",
@@ -701,6 +863,21 @@ def main(argv=None) -> int:
         raise SystemExit("--sharded/--bf16 require --fleet")
     if args.bf16 and not args.sharded:
         raise SystemExit("--bf16 requires --sharded")
+    if args.tenants:
+        if not args.fleet:
+            raise SystemExit("--tenants requires --fleet")
+        if args.log_dir or args.init_policy:
+            raise SystemExit(
+                "--tenants names each lane's checkpoint dir itself; "
+                "drop the positional log_dir / --init-policy"
+            )
+        if args.sharded or args.port is not None or args.scenario:
+            raise SystemExit(
+                "--tenants does not combine with --sharded/--port/"
+                "--scenario yet (lanes + sharded big-rung is an open "
+                "item, and the HTTP frontend wraps one router)"
+            )
+        return _run_tenants(args)
 
     if args.scenario:
         # Resolve against the registry BEFORE the expensive part

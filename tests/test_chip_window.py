@@ -509,6 +509,71 @@ def test_check_bench_record_gates():
         },
         [], [],
     ) == []
+    # Multi-tenant serving fields (serving/tenancy), validated whenever
+    # present: isolation ratio finite >= 1 beside per-tenant rates,
+    # every lane rate finite positive, per-lane step monotonicity
+    # violations exactly 0, shared_rung_compiles EXACTLY 1 per
+    # (arch, rung) — 0 = never warmed, 2+ = a lane retraced instead of
+    # sharing the executable.
+    tenancy_ok = {
+        **clean,
+        "tenant_isolation_p95_ratio": 1.4,
+        "model_formation-a__requests_per_sec": 120.0,
+        "model_formation-b__requests_per_sec": 115.0,
+        "model_pursuit__requests_per_sec": 98.0,
+        "model_formation-a__step_monotonic_violations": 0,
+        "shared_rung_compiles": {
+            "MLPActorCritic_h8x8_obs6_act2:rung1": 1,
+            "MLPActorCritic_h8x8_obs6_act2:rung8": 1,
+            "GNNActorCritic_h8x8_obs9_act2:rung1": 1,
+        },
+    }
+    assert check(tenancy_ok, [], []) == []
+    assert check(
+        {**tenancy_ok, "tenant_isolation_p95_ratio": 0.3}, [], []
+    )
+    assert check(
+        {**tenancy_ok, "tenant_isolation_p95_ratio": float("inf")}, [], []
+    )
+    assert check(
+        {**tenancy_ok, "tenant_isolation_p95_ratio": "isolated"}, [], []
+    )
+    assert check(  # ratio with no lane rates beside it
+        {**clean, "tenant_isolation_p95_ratio": 1.1}, [], []
+    )
+    assert check(
+        {**tenancy_ok, "model_pursuit__requests_per_sec": 0.0}, [], []
+    )
+    assert check(
+        {**tenancy_ok, "model_pursuit__requests_per_sec": "fast"}, [], []
+    )
+    assert check(
+        {**tenancy_ok, "model_formation-a__step_monotonic_violations": 2},
+        [], [],
+    )
+    assert check({**tenancy_ok, "shared_rung_compiles": {}}, [], [])
+    assert check(
+        {**tenancy_ok, "shared_rung_compiles": "one each"}, [], []
+    )
+    bad_shared = dict(tenancy_ok["shared_rung_compiles"])
+    bad_shared["MLPActorCritic_h8x8_obs6_act2:rung1"] = 2  # retrace
+    assert check(
+        {**tenancy_ok, "shared_rung_compiles": bad_shared}, [], []
+    )
+    bad_shared["MLPActorCritic_h8x8_obs6_act2:rung1"] = 0  # never warmed
+    assert check(
+        {**tenancy_ok, "shared_rung_compiles": bad_shared}, [], []
+    )
+    # Skipped sentinels honored across the tenancy fields.
+    assert check(
+        {
+            **clean,
+            "tenant_isolation_p95_ratio": "skipped",
+            "model_formation-a__requests_per_sec": "skipped",
+            "shared_rung_compiles": "skipped",
+        },
+        [], [],
+    ) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

@@ -1693,6 +1693,47 @@ FIXTURES = [
             return random.randint(0, 100)  # host code path: allowed
         """,
     ),
+    (
+        # Rule 24, tenancy-flavored: per-lane request counters shared
+        # between a submitting caller and a background drain thread
+        # (the serving/tenancy/fleet.py shape). The bad twin bumps the
+        # lane's tally outside its annotated lock; the good twin holds
+        # it.
+        "unguarded-shared-mutation",
+        """
+        import threading
+
+        class LaneCounters:
+            def __init__(self, lanes):
+                self._count_lock = threading.Lock()
+                self.requests = dict()  # graftlock: guarded-by=_count_lock
+                for mid in lanes:
+                    self.requests[mid] = 0
+
+            def start(self):
+                threading.Thread(target=self._drain, daemon=True).start()
+
+            def _drain(self):
+                self.requests = {mid: 0 for mid in self.requests}
+        """,
+        """
+        import threading
+
+        class LaneCounters:
+            def __init__(self, lanes):
+                self._count_lock = threading.Lock()
+                self.requests = dict()  # graftlock: guarded-by=_count_lock
+                for mid in lanes:
+                    self.requests[mid] = 0
+
+            def start(self):
+                threading.Thread(target=self._drain, daemon=True).start()
+
+            def _drain(self):
+                with self._count_lock:
+                    self.requests = {mid: 0 for mid in self.requests}
+        """,
+    ),
 ]
 
 
@@ -1742,6 +1783,22 @@ def test_package_scan_covers_serving():
     assert len(mesh) >= 6, (
         f"serving/mesh/ missing from the scan (rule 21's subject must "
         f"itself stay pinned at 0): {served}"
+    )
+
+
+def test_package_scan_covers_tenancy():
+    """The zero-violation pin must include serving/tenancy/ — the
+    multi-tenant lane layer mutates shared per-lane counters from
+    client threads and arms coordinators per lane, exactly the shapes
+    rules 24/25 police; an exclude entry or package move cannot
+    silently drop it from the scan."""
+    from marl_distributedformation_tpu.analysis import load_config
+    from marl_distributedformation_tpu.analysis.linter import iter_python_files
+
+    files = list(iter_python_files([PACKAGE], load_config(REPO), root=REPO))
+    tenancy = {f.name for f in files if "tenancy" in f.parts}
+    assert {"directory.py", "fleet.py", "smoke.py"} <= tenancy, (
+        f"serving/tenancy/ missing from the lint scan: {tenancy}"
     )
 
 

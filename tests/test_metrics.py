@@ -243,6 +243,51 @@ def test_exposition_over_merged_namespace():
     assert any(ln.startswith("marl_queue_depth{replica=") for ln in samples)
 
 
+def test_exposition_folds_tenant_model_labels():
+    """Per-tenant ``model_{id}__{metric}`` keys (serving/tenancy) fold
+    into ONE family per metric with a ``model`` label — N lanes are one
+    label dimension, not N metric names — and every rendered sample
+    still parses under the exposition line grammar. Lane names carry
+    the full allowed alphabet (dots, dashes, single underscores); the
+    double-underscore delimiter keeps the split unambiguous."""
+    snap = {
+        "model_formation-a__step": 200.0,
+        "model_formation-a__requests_total": 7.0,
+        "model_form_b.v2__step": 100.0,
+        "model_form_b.v2__requests_total": 3.0,
+        "model_pursuit__queue_depth": 0.0,
+        # A per-lane percentile composes BOTH folds: model + quantile
+        # labels on one summary family.
+        "model_pursuit__latency_p95_ms": 2.5,
+        "model_step": 200.0,  # no double underscore: stays a plain gauge
+    }
+    text = prometheus_exposition(snap)
+    lines = text.strip().splitlines()
+    samples = [ln for ln in lines if not ln.startswith("#")]
+    for line in samples:
+        assert _PROM_LINE.match(line), f"unparseable sample: {line!r}"
+    types = {
+        ln.split()[2]: ln.split()[3] for ln in lines if ln.startswith("# TYPE")
+    }
+    # One family per metric, model-labeled; counters stay counters.
+    assert types["marl_model_step"] == "gauge"
+    assert types["marl_model_requests_total"] == "counter"
+    assert types["marl_model_latency_ms"] == "summary"
+    steps = [ln for ln in samples if ln.startswith("marl_model_step{")]
+    assert {'model="formation-a"', 'model="form_b.v2"'} == {
+        ln[ln.index("{") + 1 : ln.index("}")] for ln in steps
+    }
+    assert any(
+        ln.startswith("marl_model_latency_ms{")
+        and 'model="pursuit"' in ln
+        and 'quantile="0.95"' in ln
+        for ln in samples
+    )
+    # The fleet-wide max rides the same family name UNlabeled (no
+    # double underscore to fold on).
+    assert "marl_model_step 200.0" in samples
+
+
 # ---------------------------------------------------------------------------
 # TelemetryServer
 # ---------------------------------------------------------------------------

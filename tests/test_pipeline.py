@@ -254,18 +254,38 @@ def test_promotion_log_schema(tmp_path):
         assert r["schema"] == PROMOTIONS_SCHEMA
         assert isinstance(r["time"], float)
         assert isinstance(r["step"], int)
+        # Schema 5: every line carries the lane stamp — None for a
+        # single-model pipeline like this one.
+        assert r["model_id"] is None
     # Append-only JSONL: every line independently parseable.
     lines = (tmp_path / "promotions.jsonl").read_text().splitlines()
     assert all(json.loads(ln) for ln in lines)
 
 
+def test_promotion_log_stamps_model_id(tmp_path):
+    """A lane-keyed log (serving/tenancy) stamps its model_id on EVERY
+    line, and the round trip preserves it verbatim."""
+    path = tmp_path / "promotions.jsonl"
+    log = PromotionLog(path, model_id="formation-a")
+    log.append("promoted", step=10, checkpoint="x")
+    log.append("rejected", step=20, checkpoint="y", reasons=["bad"])
+    for rec in PromotionLog.read(path):
+        assert rec["schema"] == PROMOTIONS_SCHEMA
+        assert rec["model_id"] == "formation-a"
+    # The raw lines carry the stamp too (the log is read by jq-grade
+    # tooling, not only PromotionLog.read).
+    for line in path.read_text().splitlines():
+        assert json.loads(line)["model_id"] == "formation-a"
+
+
 def test_promotion_log_reader_accepts_old_schemas_rejects_unknown(tmp_path):
     """Schema bumps 1 -> 2 (trace_id + spans) -> 3 (adversarial
-    falsifiers) -> 4 (mesh host_count/commit_round): old logs stay
-    readable — the reader backfills the newer fields as None so
-    schema-4 consumers need no per-line branching — and an UNKNOWN
-    (future) schema fails loudly instead of being silently misread."""
-    assert PROMOTIONS_SCHEMA == 4
+    falsifiers) -> 4 (mesh host_count/commit_round) -> 5 (tenant
+    model_id): old logs stay readable — the reader backfills the newer
+    fields as None so schema-5 consumers need no per-line branching —
+    and an UNKNOWN (future) schema fails loudly instead of being
+    silently misread."""
+    assert PROMOTIONS_SCHEMA == 5
     path = tmp_path / "promotions.jsonl"
     with open(path, "w") as f:
         f.write(json.dumps({  # a verbatim PR-7-era line
@@ -296,6 +316,8 @@ def test_promotion_log_reader_accepts_old_schemas_rejects_unknown(tmp_path):
     assert oldest["host_count"] is None and oldest["commit_round"] is None
     assert obs_era["host_count"] is None
     assert new["host_count"] is None and new["commit_round"] is None
+    # Schema 5 backfill: pre-tenancy lines are the None lane.
+    assert oldest["model_id"] is None and obs_era["model_id"] is None
     # A schema-4 line written with the adversarial rung OFF has no
     # falsifiers key either — the reader backfills None there too, so
     # consumers never branch per line (or KeyError) on gate config.
