@@ -1600,6 +1600,112 @@ def main() -> None:
                     notes.append(f"serving slo phase failed: {e!r}"[:200])
             else:
                 notes.append("serving slo phase skipped: deadline")
+        # Phase 9b — elastic capacity (serving/elastic/, docs/serving.md
+        # "Elastic capacity"): one shifting-mix day — interactive-heavy
+        # first half, big-rung storm second half — against a STATIC
+        # fleet whose split+ladder were autotuned on the first half and
+        # frozen, and an ELASTIC fleet whose CapacityController replays
+        # the live TraceRecorder window through the same DP and
+        # re-splits at the fleet batch barrier (prewarm-then-commit).
+        # Both measured on the storm half by the same rate bisection;
+        # the barrier pause, prewarm compile attribution (census diff:
+        # zero programs registered during the measured storm), and
+        # budget-1 receipts ride along.
+        if os.environ.get("BENCH_SKIP_SERVING") == "1":
+            _mark_skipped(
+                result,
+                "elastic",
+                (
+                    "serving_req_per_sec_at_p95_slo_elastic",
+                    "serving_req_per_sec_at_p95_slo_static",
+                    "elastic_resplit_pause_ms",
+                    "elastic_prewarm_compiles",
+                ),
+            )
+        else:
+            if time.time() < deadline - 90:
+                try:
+                    ela_s = float(
+                        os.environ.get("BENCH_ELASTIC_DURATION_S", 2.0)
+                    )
+                    ela_p95 = float(
+                        os.environ.get("BENCH_ELASTIC_P95_MS", 80.0)
+                    )
+                    cmd = [
+                        sys.executable,
+                        os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "serve_policy.py",
+                        ),
+                        "--init-policy", "MLPActorCritic",
+                        "--obs-dim", "8", "--hidden", "64,64",
+                        "--elastic-bench", "--replicas", "2",
+                        "--duration", str(ela_s),
+                        "--load-rps", "120",
+                        "--slo-p95-ms", str(ela_p95),
+                        "--slo-iterations", "4",
+                    ]
+                    env = dict(os.environ)
+                    env["JAX_PLATFORMS"] = "cpu"
+                    env["XLA_FLAGS"] = (
+                        env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                    ).strip()
+                    out = subprocess.run(
+                        cmd, capture_output=True, text=True,
+                        timeout=max(deadline - time.time(), 90),
+                        env=env,
+                    )
+                    if out.returncode != 0:
+                        raise RuntimeError(
+                            f"elastic bench exited {out.returncode}: "
+                            + out.stderr[-200:]
+                        )
+                    rep = json.loads(out.stdout.strip().splitlines()[-1])
+                    result["serving_req_per_sec_at_p95_slo_elastic"] = (
+                        round(rep["req_per_sec_at_p95_slo_elastic"], 1)
+                    )
+                    result["serving_req_per_sec_at_p95_slo_static"] = (
+                        round(rep["req_per_sec_at_p95_slo_static"], 1)
+                    )
+                    result["elastic_resplit_pause_ms"] = round(
+                        rep["elastic_resplit_pause_ms"], 3
+                    )
+                    result["elastic_prewarm_compiles"] = int(
+                        rep["elastic_prewarm_compiles"]
+                    )
+                    result["elastic_storm_new_programs"] = int(
+                        rep["elastic_storm_new_programs"]
+                    )
+                    result["elastic_resplits_committed"] = int(
+                        rep["elastic_resplits_committed"]
+                    )
+                    result["elastic_max_compiles_per_rung"] = int(
+                        rep["max_compiles_per_rung"]
+                    )
+                    result["elastic_storm_p95_ms"] = round(
+                        rep["elastic_storm_p95_ms"], 2
+                    )
+                    result["elastic_static_storm_p95_ms"] = round(
+                        rep["static_storm_p95_ms"], 2
+                    )
+                    result["elastic_buckets"] = rep["elastic_buckets"]
+                    print(
+                        "[bench] elastic capacity (2-device CPU, storm "
+                        "half): "
+                        f"{rep['req_per_sec_at_p95_slo_elastic']:,.0f} "
+                        "req/s elastic vs "
+                        f"{rep['req_per_sec_at_p95_slo_static']:,.0f} "
+                        f"static at p95<={ela_p95:.0f}ms; re-split "
+                        f"pause {rep['elastic_resplit_pause_ms']:.2f}ms,"
+                        f" {rep['elastic_prewarm_compiles']:.0f} prewarm"
+                        " compiles (0 on the storm path)",
+                        file=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade, don't die
+                    notes.append(f"elastic phase failed: {e!r}"[:200])
+            else:
+                notes.append("elastic phase skipped: deadline")
         # Phase 10 — adversarial robustness (scenarios/adversary.py,
         # docs/adversarial.md): the falsifier search throughput + its
         # budget-1 compile receipt, and the auto-curriculum payoff at

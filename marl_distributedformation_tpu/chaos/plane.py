@@ -136,6 +136,17 @@ INJECTION_POINTS: Dict[str, Tuple[str, ...]] = {
     # every host must resume on the old step.
     "mesh.prepare": ("wedge", "raise", "delay"),
     "mesh.commit": ("raise", "delay"),
+    # serving/elastic — the capacity controller's re-split seams. A
+    # raise at prewarm aborts the round before anything routes (old
+    # split keeps serving, compiles already paid are receipted and
+    # reusable); at commit it fires INSIDE the closed barrier before
+    # the membership swap (the swap is one list assignment — nothing
+    # to untear, gates reopen on the old split); at retire it fires in
+    # the drain worker AFTER the new split routes (the retired replica
+    # is stopped undrained and its queued requests fail over).
+    "elastic.prewarm": ("raise", "delay"),
+    "elastic.commit": ("raise", "delay"),
+    "elastic.retire": ("raise", "delay"),
 }
 
 

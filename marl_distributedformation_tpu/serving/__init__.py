@@ -49,6 +49,11 @@ is compiled:
   (``autotune_ladder``). SLO classes ride admission control —
   batch-eval traffic yields to interactive under backpressure
   (``MicroBatchScheduler.submit(slo_class=...)``).
+- ``serving.elastic`` — the live capacity loop: ``TraceRecorder``
+  captures offered arrivals at the schedulers, ``CapacityController``
+  replays the window through the same autotune DP and re-splits the
+  fleet (new ladder, new replicated/sharded device split) with
+  prewarm-then-commit at the fleet batch barrier.
 
 Architecture, bucket-ladder sizing, backpressure semantics, and the
 hot-reload contract are documented in ``docs/serving.md``.
@@ -57,6 +62,8 @@ hot-reload contract are documented in ``docs/serving.md``.
 from marl_distributedformation_tpu.serving.autotune import (
     LadderPlan,
     autotune_ladder,
+    plans_equivalent,
+    replay_recorder,
 )
 from marl_distributedformation_tpu.serving.client import (
     ServingClient,
@@ -66,8 +73,13 @@ from marl_distributedformation_tpu.serving.engine import (
     DEFAULT_BUCKETS,
     BucketedPolicyEngine,
 )
+from marl_distributedformation_tpu.serving.elastic import (
+    CapacityController,
+    CapacityDecision,
+)
 from marl_distributedformation_tpu.serving.loadgen import (
     RequestTrace,
+    TraceRecorder,
     max_rate_at_slo,
     run_load,
     synthetic_trace,
@@ -91,6 +103,8 @@ from marl_distributedformation_tpu.serving.smoke import run_smoke_benchmark
 __all__ = [
     "BackpressureError",
     "BucketedPolicyEngine",
+    "CapacityController",
+    "CapacityDecision",
     "DEFAULT_BUCKETS",
     "LadderPlan",
     "MicroBatchScheduler",
@@ -104,9 +118,12 @@ __all__ = [
     "ServingMetrics",
     "ShardedPolicyEngine",
     "ShardedSpec",
+    "TraceRecorder",
     "autotune_ladder",
     "backoff_s",
     "max_rate_at_slo",
+    "plans_equivalent",
+    "replay_recorder",
     "run_load",
     "run_smoke_benchmark",
     "synthetic_trace",

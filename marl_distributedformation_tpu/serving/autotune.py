@@ -25,10 +25,14 @@ distribution and arrival rate of a :class:`~.loadgen.RequestTrace`
   stay on the replicated single-device engines — the router's routing
   threshold falls out of the same plan.
 
-The autotuner is advisory-by-construction: it emits a plan, the
-operator (or bench harness) builds engines from it. Nothing retunes a
-live fleet under traffic — a rung change means new compiles, which is
-exactly what the budget-1 RetraceGuards exist to make deliberate.
+The DP is pure — one trace in, one plan out — so the SAME plan shape
+serves two callers: offline (the bench harness builds a fleet from a
+plan before traffic) and live (serving/elastic replays the recent
+recorded window through :func:`replay_recorder` and lands the new plan
+at the fleet batch barrier after prewarming every rung off the serving
+path). A rung change still means new compiles — the elastic controller
+pays them at prewarm, where the budget-1 RetraceGuards receipt them
+deliberately, never on the request path.
 """
 
 from __future__ import annotations
@@ -257,4 +261,44 @@ def autotune_ladder(
         observed_rps=trace.offered_rps,
         mean_rows_per_request=mean_rows,
         sharded_window_ms=sharded_window_ms,
+    )
+
+
+def replay_recorder(
+    recorder: "object",
+    p95_target_ms: float,
+    min_requests: int = 64,
+    **autotune_kwargs: object,
+) -> Optional[LadderPlan]:
+    """The incremental live entrypoint: replay a
+    :class:`~.loadgen.TraceRecorder`'s recent window through the exact
+    same DP. Returns None below ``min_requests`` recorded arrivals — a
+    ladder re-derived from a handful of requests would flap, and every
+    flap costs prewarm compiles."""
+    if len(recorder) < max(2, int(min_requests)):  # type: ignore[arg-type]
+        return None
+    trace = recorder.to_trace()  # type: ignore[attr-defined]
+    if trace is None:
+        return None
+    return autotune_ladder(trace, p95_target_ms, **autotune_kwargs)
+
+
+def plans_equivalent(
+    a: Optional[LadderPlan],
+    b: Optional[LadderPlan],
+    window_tol_ms: float = 1.0,
+) -> bool:
+    """Hysteresis predicate: two plans that would build the same
+    engines (same rung ladders, same sharded split, windows within
+    ``window_tol_ms``) are the same capacity decision — re-splitting
+    between them would pay prewarm compiles and a barrier pause to
+    change nothing."""
+    if a is None or b is None:
+        return a is b
+    return (
+        a.replicated_buckets == b.replicated_buckets
+        and a.sharded_buckets == b.sharded_buckets
+        and abs(a.window_ms - b.window_ms) <= window_tol_ms
+        and abs(a.sharded_window_ms - b.sharded_window_ms)
+        <= window_tol_ms
     )

@@ -1,0 +1,8 @@
+"""Elastic capacity: the live re-split control loop (controller.py)."""
+
+from marl_distributedformation_tpu.serving.elastic.controller import (
+    CapacityController,
+    CapacityDecision,
+)
+
+__all__ = ["CapacityController", "CapacityDecision"]

@@ -421,6 +421,163 @@ class FleetReloadCoordinator:
             raw, template, origin=str(path)
         )["params"]
 
+    # -- elastic re-split (serving/elastic) ------------------------------
+
+    def commit_resplit(
+        self,
+        add: Any = (),
+        retire: Any = (),
+        sharded_min_rows: Optional[int] = None,
+        trace_id: Optional[str] = None,
+    ) -> dict:
+        """Land a capacity re-split — replicas added, replicas retired,
+        the big-rung routing threshold re-pinned — at the SAME fleet
+        batch barrier a reload commits at, so no in-flight request ever
+        observes a torn replica set and ``model_step`` monotonicity is
+        untouched (added replicas must already serve the current fleet
+        step; a prewarm the fleet stepped past is refused, the
+        controller retries).
+
+        ``add`` replicas come PREWARMED from the controller: engines
+        built, every rung compiled off the serving path, schedulers
+        started but unrouted. ``retire`` names replica indices to swap
+        out of routing; the CALLER drains and stops them after the
+        gates reopen (``router.drain_replica`` — drain-before-retire
+        must not extend the serving pause).
+
+        Returns a report dict; never raises. ``committed`` False means
+        the old split keeps serving and ``load_errors`` records why.
+        ``pause_ms`` is the barrier-commit pause only — gates closed to
+        gates reopened — which is the whole serving interruption a
+        re-split costs (prewarm compiles happen before, drains after).
+        """
+        if self.model_id is not None:
+            raise ValueError(
+                "elastic re-split over a lane-keyed coordinator is not "
+                "supported yet (docs/serving.md 'Limits / next')"
+            )
+        add = list(add)
+        retire_set = {int(i) for i in retire}
+        tracer = get_tracer()
+        report: dict = {
+            "committed": False,
+            "pause_ms": 0.0,
+            "added": [r.index for r in add],
+            "retired": sorted(retire_set),
+        }
+        with self._refresh_lock:
+            current = list(self.router.replicas)
+            known = {r.index for r in current}
+            missing = retire_set - known
+            if missing:
+                self.load_errors.append(
+                    (
+                        "resplit",
+                        f"resplit refused: retire names unknown "
+                        f"replicas {sorted(missing)}",
+                    )
+                )
+                return report
+            stale = [
+                r.index
+                for r in add
+                if r.registry.active_step != self._fleet_step
+            ]
+            if stale:
+                # The fleet stepped forward while the controller was
+                # prewarming: committing these replicas would serve an
+                # older step after a newer one — exactly the
+                # monotonicity violation the barrier exists to prevent.
+                self.load_errors.append(
+                    (
+                        "resplit",
+                        f"resplit refused: prewarmed replicas {stale} "
+                        f"serve a step != fleet step {self._fleet_step} "
+                        "(reload landed during prewarm); re-prewarm and "
+                        "retry",
+                    )
+                )
+                report["stale_prewarm"] = True
+                return report
+            barriers = [r.registry.batch_lock for r in current]
+            held = []
+            wedged_replica = None
+            t_closed = 0.0
+            t_open = 0.0
+            try:
+                for b in barriers:
+                    b.close()
+                t_closed = time.perf_counter()
+                for i, b in enumerate(barriers):
+                    fault_point("fleet.barrier")
+                    acquired = b.acquire(timeout=self.commit_timeout_s)
+                    if not acquired:
+                        self.load_errors.append(
+                            (
+                                "resplit",
+                                f"resplit aborted: replica {i} barrier "
+                                f"not acquired in {self.commit_timeout_s}"
+                                "s (wedged dispatch?); old split keeps "
+                                "serving",
+                            )
+                        )
+                        wedged_replica = i
+                        return report
+                    held.append(b)
+                with tracer.span(
+                    "elastic.commit",
+                    trace_id=trace_id,
+                    added=len(add),
+                    retired=len(retire_set),
+                ):
+                    fault_point("elastic.commit")
+                    self.router._commit_resplit(
+                        add, retire_set, sharded_min_rows=sharded_min_rows
+                    )
+                    report["committed"] = True
+                    report["step"] = self._fleet_step
+            except Exception as e:  # noqa: BLE001 — contain, keep serving
+                # The membership swap is one list assignment — a fault
+                # before it (the armed elastic.commit seam) leaves the
+                # old split fully intact; nothing to untear.
+                self.load_errors.append(
+                    (
+                        "resplit",
+                        f"resplit commit aborted: {e!r}; old split "
+                        "keeps serving",
+                    )
+                )
+                report["error"] = repr(e)
+                return report
+            finally:
+                for b in reversed(held):
+                    b.release()
+                for b in barriers:
+                    b.open()
+                t_open = time.perf_counter()
+                report["pause_ms"] = round(
+                    max(0.0, (t_open - t_closed)) * 1e3, 3
+                )
+                if wedged_replica is not None:
+                    tracer.incident(
+                        "wedged_barrier_abort",
+                        trace_id=trace_id,
+                        replica=wedged_replica,
+                        step=self._fleet_step,
+                        path="resplit",
+                        commit_timeout_s=self.commit_timeout_s,
+                    )
+        # Both the retiring and the incoming engines' params are live
+        # here — the same double-residency shape a reload peaks at.
+        # Sample AFTER the gates reopened: the watermark read must not
+        # extend the pause it is measuring.
+        from marl_distributedformation_tpu.analysis.guards import (
+            sample_device_watermark,
+        )
+
+        sample_device_watermark(force=True)
+        return report
+
     # -- cross-host staged two-phase (serving/mesh) ----------------------
     #
     # The mesh coordinator generalizes the batch-barrier commit across

@@ -124,6 +124,10 @@ class FleetRouter:
         single-device replicas). A broken sharded replica fails its
         big requests over to the replicated ladder like any other
         circuit break.
+      trace_recorder: optional ``loadgen.TraceRecorder`` shared by every
+        replica's scheduler — the interleaved record across schedulers
+        IS the fleet-wide arrival process the elastic retuner replays
+        (serving/elastic) and ``--record-trace`` dumps.
       lanes: optional ``model_id`` → ``(params, step)`` mapping — turns
         every replica multi-tenant (serving/tenancy): each lane gets
         its own device-resident ``ReplicaRegistry`` cell (own batch
@@ -158,6 +162,7 @@ class FleetRouter:
         sharded: Any = None,
         lanes: Any = None,
         tenant_max_queue: Optional[int] = None,
+        trace_recorder: Any = None,
     ) -> None:
         import jax
 
@@ -184,6 +189,15 @@ class FleetRouter:
         self.metrics = metrics or FleetMetrics()
         self.logger = logger
         self.emit_every = emit_every
+        self.trace_recorder = trace_recorder
+        # Construction knobs kept for the elastic rebuild path
+        # (build_replica / build_sharded_replica): a re-split builds
+        # replicas the same way the constructor did, just later.
+        self._devices = devs
+        self._buckets = tuple(buckets)
+        self._window_ms = float(window_ms)
+        self._max_queue = int(max_queue)
+        self._seed = int(seed)
         self._health_lock = threading.Lock()
         self._stopping = False
         self.replicas: List[Replica] = []
@@ -213,6 +227,7 @@ class FleetRouter:
                     tenant_max_queue=tenant_max_queue,
                     window_ms=window_ms,
                     default_timeout_s=default_timeout_s,
+                    trace_recorder=trace_recorder,
                 )
             else:
                 registries = None
@@ -227,6 +242,7 @@ class FleetRouter:
                     max_queue=max_queue,
                     window_ms=window_ms,
                     default_timeout_s=default_timeout_s,
+                    trace_recorder=trace_recorder,
                 )
             self.replicas.append(
                 Replica(
@@ -286,6 +302,7 @@ class FleetRouter:
                     else sharded.window_ms
                 ),
                 default_timeout_s=default_timeout_s,
+                trace_recorder=trace_recorder,
             )
             self.sharded_replica = Replica(
                 index=n,
@@ -297,6 +314,10 @@ class FleetRouter:
             )
             self.replicas.append(self.sharded_replica)
             self._sharded_min_rows = sharded.route_min_rows
+        # Replica indices are never reused across re-splits: metric and
+        # report keys (``replica{i}_*``) stay unambiguous for the whole
+        # process lifetime.
+        self._next_index = len(self.replicas)  # graftlock: guarded-by=_health_lock
 
     # -- lifecycle -------------------------------------------------------
 
@@ -575,13 +596,194 @@ class FleetRouter:
         """Stop one replica's worker (chaos hook, used by tests and the
         smoke storm). Its queued requests fail with ``SchedulerStopped``
         and the failover path re-routes them to surviving replicas."""
-        replica = self.replicas[index]
+        # Lookup by Replica.index, not list position: after an elastic
+        # re-split the two diverge (indices are never reused).
+        replica = next(
+            (r for r in self.replicas if r.index == index), None
+        )
+        if replica is None:
+            raise KeyError(f"no replica with index {index}")
         self._break(replica, reason)
         replica.scheduler.stop()
 
     @property
     def healthy_replicas(self) -> int:
         return sum(1 for r in self.replicas if r.healthy)
+
+    # -- elasticity (serving/elastic) ------------------------------------
+
+    def fleet_params(self) -> Tuple[Any, int]:
+        """The ``(params, step)`` the fleet currently serves — a
+        replicated replica's cell when one exists (host-transferable
+        single-device tree), else the sharded cell. The coordinator
+        commits every cell identically, so any cell is authoritative."""
+        for r in self.replicas:
+            if r.kind == "replicated":
+                return r.registry.active()
+        return self.replicas[0].registry.active()
+
+    def _alloc_index(self) -> int:
+        with self._health_lock:
+            index = self._next_index
+            self._next_index += 1
+            return index
+
+    def build_replica(
+        self,
+        device: Any = None,
+        buckets: Optional[Tuple[int, ...]] = None,
+        window_ms: Optional[float] = None,
+    ) -> Replica:
+        """Build one UNROUTED replicated replica at the fleet's current
+        ``(params, step)`` — the elastic prewarm path. The scheduler is
+        constructed but NOT started and nothing routes here until the
+        replica lands via ``FleetReloadCoordinator.commit_resplit``;
+        the caller warms every rung (with the registry's params, the
+        ``warmup_fleet`` contract) off the serving path first."""
+        import jax
+
+        if self.lane_ids:
+            raise ValueError(
+                "elastic re-split over tenant lanes is not supported "
+                "yet (docs/serving.md 'Limits / next')"
+            )
+        index = self._alloc_index()
+        dev = (
+            device
+            if device is not None
+            else self._devices[index % len(self._devices)]
+        )
+        params, step = self.fleet_params()
+        engine = BucketedPolicyEngine(
+            self.policy,
+            buckets=tuple(buckets) if buckets is not None else self._buckets,
+            seed=self._seed + index,
+        )
+        registry = ReplicaRegistry(
+            jax.device_put(params, dev), step=step, device=dev
+        )
+        scheduler = MicroBatchScheduler(
+            engine,
+            registry=registry,
+            max_queue=self._max_queue,
+            window_ms=(
+                self._window_ms if window_ms is None else float(window_ms)
+            ),
+            default_timeout_s=self.default_timeout_s,
+            trace_recorder=self.trace_recorder,
+        )
+        return Replica(
+            index=index,
+            device=dev,
+            engine=engine,
+            scheduler=scheduler,
+            registry=registry,
+        )
+
+    def build_sharded_replica(self, spec: Any) -> Replica:
+        """Build one UNROUTED mesh-backed big-rung replica from a
+        ``serving.sharded.ShardedSpec`` at the fleet's current
+        ``(params, step)`` — same construction as the boot path, but
+        the slice adopts the params the fleet serves NOW (the boot copy
+        from ``policy.params`` would resurrect a stale step after any
+        reload). Routing of big requests flips to the new slice only
+        when ``commit_resplit`` lands it."""
+        from marl_distributedformation_tpu.parallel.mesh import make_mesh
+        from marl_distributedformation_tpu.serving.sharded import (
+            ShardedPolicyEngine,
+        )
+
+        if self.lane_ids:
+            raise ValueError(
+                "elastic re-split over tenant lanes is not supported "
+                "yet (docs/serving.md 'Limits / next')"
+            )
+        index = self._alloc_index()
+        mesh = make_mesh(
+            dict(spec.axis_sizes or {"dp": len(self._devices)})
+        )
+        engine = ShardedPolicyEngine(
+            self.policy,
+            mesh,
+            buckets=spec.buckets,
+            rules=spec.rules,
+            seed=self._seed + index,
+            dtype=spec.dtype,
+        )
+        params, step = self.fleet_params()
+        # Adopt the CURRENT fleet params onto the slice (replacing the
+        # boot copy — no double residency) and seed the registry from
+        # the same tree, exactly like the constructor's sharded path.
+        engine.adopt_params(params)
+        registry = ReplicaRegistry(
+            engine._params_on_mesh,
+            step=step,
+            device=engine.param_shardings,
+        )
+        scheduler = MicroBatchScheduler(
+            engine,
+            registry=registry,
+            max_queue=self._max_queue,
+            window_ms=(
+                self._window_ms
+                if spec.window_ms is None
+                else spec.window_ms
+            ),
+            default_timeout_s=self.default_timeout_s,
+            trace_recorder=self.trace_recorder,
+        )
+        return Replica(
+            index=index,
+            device=mesh,
+            engine=engine,
+            scheduler=scheduler,
+            registry=registry,
+            kind="sharded",
+        )
+
+    # graftlock: holds=batch_lock
+    def _commit_resplit(
+        self,
+        add: Sequence[Replica],
+        retire: Set[int],
+        sharded_min_rows: Optional[int] = None,
+    ) -> None:
+        """Swap routing membership — coordinator-only, called from
+        ``FleetReloadCoordinator.commit_resplit`` at the fleet batch
+        barrier with every CURRENT replica's lock held (zero batches in
+        flight anywhere). One list assignment under the health lock:
+        requests racing the commit see either the old set or the new
+        set, never a torn one."""
+        with self._health_lock:
+            kept = [r for r in self.replicas if r.index not in retire]
+            self.replicas = kept + list(add)
+            shards = [r for r in self.replicas if r.kind == "sharded"]
+            self.sharded_replica = shards[-1] if shards else None
+            if self.sharded_replica is None:
+                self._sharded_min_rows = 0
+            elif sharded_min_rows is not None:
+                self._sharded_min_rows = int(sharded_min_rows)
+
+    def drain_replica(
+        self, replica: Replica, timeout_s: float = 10.0
+    ) -> bool:
+        """Drain-before-retire: wait for a DE-ROUTED replica (already
+        swapped out by ``commit_resplit`` — no new submits can reach
+        it) to finish its queued work and go idle, then stop its
+        worker. Returns True on a clean drain; on timeout the worker
+        is stopped anyway and its still-queued requests fail with
+        ``SchedulerStopped``, which the normal failover path re-routes
+        onto the live replicas."""
+        deadline = time.perf_counter() + timeout_s
+        drained = False
+        while time.perf_counter() < deadline:
+            sched = replica.scheduler
+            if sched.queue_depth == 0 and not sched._busy:
+                drained = True
+                break
+            time.sleep(0.002)
+        replica.scheduler.stop()
+        return drained
 
     # -- observability ---------------------------------------------------
 

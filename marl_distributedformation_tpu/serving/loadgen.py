@@ -19,6 +19,10 @@ This module provides:
   (:func:`save_trace` / :func:`load_trace`). Traces are deterministic
   given a seed — the ladder autotuner (autotune.py) consumes the same
   trace the bench drives, so its decisions are reproducible.
+- :class:`TraceRecorder` — a bounded ring the schedulers record LIVE
+  arrivals into; its window replays through the same autotuner DP
+  (serving/elastic) and dumps as the same JSONL
+  (``serve_policy.py --record-trace``).
 - :func:`run_load` — open-loop replay of a trace against anything with
   ``submit`` (scheduler or router): arrivals are scheduled on the trace
   clock regardless of completions; rejects/timeouts are counted, not
@@ -36,6 +40,7 @@ import dataclasses
 import json
 import threading
 import time
+from collections import deque
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -163,6 +168,86 @@ def load_trace(path: str | Path) -> RequestTrace:
         sizes=np.asarray(sizes, np.int64),
         slo_classes=tuple(classes),
     )
+
+
+class TraceRecorder:
+    """Bounded ring of LIVE arrivals, replayable as a
+    :class:`RequestTrace`.
+
+    The schedulers record every offered request (rows + SLO class,
+    stamped at admission time) into one shared recorder; the elastic
+    controller (serving/elastic) replays the recent window through the
+    autotuner's exact DP, and ``serve_policy.py --record-trace`` dumps
+    it as the same JSONL :func:`load_trace` reads back — closing the
+    synthetic-only gap: the trace that retunes the fleet is the trace
+    the fleet actually served.
+
+    OFFERED load is what gets recorded — the sample lands before
+    admission control, so backpressured requests still count (a retuner
+    fed only the accepted stream would never see the overload it exists
+    to fix). The ring is bounded (``capacity`` newest arrivals) and the
+    record path is one lock + one deque append — cheap enough for the
+    submit path.
+    """
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity < 2:
+            raise ValueError(
+                f"capacity must allow at least one gap, got {capacity}"
+            )
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        # (perf_counter arrival, rows, slo_class) newest-last.
+        self._ring: "deque" = deque(maxlen=self.capacity)  # graftlock: guarded-by=_lock
+        self._recorded_total = 0  # graftlock: guarded-by=_lock
+
+    def record(
+        self, rows: int, slo_class: str = "interactive"
+    ) -> None:
+        """One offered request (called by the schedulers at submit)."""
+        now = time.perf_counter()
+        with self._lock:
+            self._ring.append((now, int(rows), str(slo_class)))
+            self._recorded_total += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def recorded_total(self) -> int:
+        """Arrivals ever recorded (the ring keeps only the newest)."""
+        with self._lock:
+            return self._recorded_total
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def to_trace(self) -> Optional[RequestTrace]:
+        """The ring as a replayable trace (None below two samples —
+        one arrival has no gap to replay). The first gap is 0: the
+        window starts at its own first arrival."""
+        with self._lock:
+            samples = list(self._ring)
+        if len(samples) < 2:
+            return None
+        times = np.asarray([t for t, _, _ in samples], np.float64)
+        gaps = np.diff(times, prepend=times[0])
+        return RequestTrace(
+            inter_arrival_s=gaps,
+            sizes=np.asarray([n for _, n, _ in samples], np.int64),
+            slo_classes=tuple(slo for _, _, slo in samples),
+        )
+
+    def save(self, path: str | Path) -> bool:
+        """Dump the ring as replayable loadgen JSONL; False when there
+        is not yet enough recorded traffic to form a trace."""
+        trace = self.to_trace()
+        if trace is None:
+            return False
+        save_trace(trace, path)
+        return True
 
 
 @dataclasses.dataclass

@@ -341,6 +341,7 @@ class MicroBatchScheduler:
         emit_every: int = 100,
         registries: Any = None,
         tenant_max_queue: Optional[int] = None,
+        trace_recorder: Any = None,
     ) -> None:
         if registries is not None and registry is not None:
             raise ValueError(
@@ -363,6 +364,10 @@ class MicroBatchScheduler:
             )
         else:
             self._queue = _ClassedQueue(maxsize=max_queue)
+        # Optional loadgen.TraceRecorder: OFFERED arrivals (rows + SLO
+        # class) recorded at submit, before admission control — the
+        # live-trace feed for the elastic retuner and --record-trace.
+        self.trace_recorder = trace_recorder
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._busy = False  # worker mid-dispatch (drain estimation)
@@ -415,6 +420,10 @@ class MicroBatchScheduler:
             raise ValueError(
                 f"obs must be (n >= 1, *row_shape), got shape {obs.shape}"
             )
+        if self.trace_recorder is not None:
+            # Before admission control: the retuner must see the
+            # backpressured arrivals too, or it never sees overload.
+            self.trace_recorder.record(int(obs.shape[0]), slo_class)
         req = _Request(
             obs=obs,
             deterministic=bool(deterministic),

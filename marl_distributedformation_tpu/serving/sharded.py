@@ -182,6 +182,14 @@ class ShardedSpec:
     def route_min_rows(self) -> int:
         return self.min_rows if self.min_rows else min(self.buckets)
 
+    def evolved(self, **changes: object) -> "ShardedSpec":
+        """A new spec with ``changes`` applied — the delta form the
+        elastic controller hands the fleet when it re-derives only part
+        of the slice config (say, new ``buckets`` from a retune while
+        the mesh axes stay put). Unknown fields raise, same as
+        ``dataclasses.replace``."""
+        return dataclasses.replace(self, **changes)
+
 
 class ShardedPolicyEngine(BucketedPolicyEngine):
     """``BucketedPolicyEngine`` whose rungs run over a device-mesh slice.
@@ -282,6 +290,16 @@ class ShardedPolicyEngine(BucketedPolicyEngine):
         return jax.tree_util.tree_map(
             lambda f, leaf: f(leaf), self._gather_fns, params
         )
+
+    def adopt_params(self, params: Any) -> Any:
+        """Replace the engine's resident tree with ``params`` placed
+        under the partition rules, and return the placed tree. The
+        elastic prewarm path uses this to put the CURRENT fleet params
+        on a freshly built slice — replacing the boot copy taken from
+        the wrapped policy, so the slice holds exactly one resident
+        tree (no double residency against the swap watermark)."""
+        self._params_on_mesh = self.shard_params(params)
+        return self._params_on_mesh
 
     # -- compiled path ---------------------------------------------------
 

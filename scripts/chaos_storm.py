@@ -104,6 +104,18 @@ SEBULBA_POINTS = (
     "sebulba.dequeue",
     "sebulba.param_publish",
 )
+# The --elastic campaign's seams (serving/elastic, docs/serving.md
+# "Elastic capacity"): the three legs of a live re-split. A raise at
+# prewarm aborts the round before anything routes (old split keeps
+# serving); at commit it fires inside the closed barrier before the
+# membership swap (one list assignment — nothing to untear); at retire
+# it hits the post-commit drain worker (the retired replica stops
+# undrained, its queued requests fail over to the new split).
+ELASTIC_POINTS = (
+    "elastic.prewarm",
+    "elastic.commit",
+    "elastic.retire",
+)
 
 # Hit windows per point: high-frequency seams (polls, worker loops) can
 # absorb faults deep into the campaign; rare seams (one hit per commit
@@ -137,6 +149,14 @@ WINDOWS = {
     "sebulba.enqueue": 10,
     "sebulba.dequeue": 10,
     "sebulba.param_publish": 6,
+    # elastic: prewarm crosses once per replica build (~2 per re-split
+    # round), commit once per round that survives prewarm, retire once
+    # per retired replica on committed rounds — windows sized so a
+    # ~6-round campaign with a few aborted rounds still fires every
+    # armed cell (the flush rounds extend the campaign until it does).
+    "elastic.prewarm": 8,
+    "elastic.commit": 4,
+    "elastic.retire": 6,
 }
 
 
@@ -922,6 +942,265 @@ def run_sebulba_campaign(
     return report
 
 
+def _widen_cpu_devices(n: int) -> None:
+    """Best-effort CPU device-pool widening (mirrors serve_policy.py's
+    _ensure_cpu_devices): the elastic campaign wants >= 2 devices so
+    re-splits exercise the sharded slice path, but runs honestly on
+    whatever pool it gets."""
+    import os
+
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    if len(jax.local_devices()) >= n or jax.default_backend() != "cpu":
+        return
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except (AttributeError, RuntimeError):
+        try:
+            import jax.extend.backend as jeb
+
+            jeb.clear_backends()
+        except Exception:  # noqa: BLE001 — widening is best-effort
+            pass
+
+
+def run_elastic_campaign(
+    seed: int = 0,
+    faults: int = 9,
+    budget_s: float = 240.0,
+    obs_dim: int = 8,
+    rounds: int = 6,
+    requests_per_round: int = 60,
+    probe_interval_s: float = 0.03,
+) -> Dict[str, Any]:
+    """The storm pointed at the elastic re-split seams
+    (serving/elastic, docs/serving.md "Elastic capacity"): a live fleet
+    serves alternating traffic mixes while a ``CapacityController``
+    re-splits it round after round, with the seeded schedule raising
+    and delaying at the prewarm, barrier-commit, and drain-retire legs.
+    Invariants: every accepted request resolves (aborted rounds keep
+    the old split serving; retire faults stop replicas undrained and
+    their queued work must fail over), served steps stay monotonic
+    through every commit, budget-1 compile receipts on the final
+    replica set, at least 2 re-splits actually committed, and every
+    armed fault fired. One JSON line out."""
+    import tempfile
+
+    import numpy as np
+
+    from marl_distributedformation_tpu.chaos import (
+        Violation,
+        check_budget_one,
+        check_no_request_lost,
+        check_step_monotonic,
+        get_fault_plane,
+        report_violations,
+    )
+    from marl_distributedformation_tpu.compat.policy import LoadedPolicy
+    from marl_distributedformation_tpu.serving import TraceRecorder
+    from marl_distributedformation_tpu.serving.elastic import (
+        CapacityController,
+    )
+    from marl_distributedformation_tpu.serving.fleet import (
+        FleetReloadCoordinator,
+        FleetRouter,
+        warmup_fleet,
+    )
+
+    t_start = time.perf_counter()
+    deadline = t_start + budget_s
+    _widen_cpu_devices(2)
+    import jax
+    import jax.numpy as jnp
+
+    from marl_distributedformation_tpu.models import MLPActorCritic
+
+    model = MLPActorCritic(act_dim=2, hidden=(8, 8))
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, obs_dim))
+    )
+    policy = LoadedPolicy(
+        dict(variables), model_kwargs={"hidden": (8, 8)}
+    )
+
+    schedule = build_schedule(seed, faults, point_names=ELASTIC_POINTS)
+    plane = get_fault_plane()
+    plane.reset()
+    report: Dict[str, Any] = {
+        "deterministic": {
+            "chaos_seed": int(seed),
+            "chaos_faults_armed": len(schedule),
+            "schedule": schedule.record(),
+        },
+    }
+    violations: List[Violation] = []
+
+    recorder = TraceRecorder()
+    router = FleetRouter(
+        policy,
+        num_replicas=2,
+        buckets=(1, 8),
+        window_ms=0.0,
+        trace_recorder=recorder,
+    )
+    workdir = tempfile.mkdtemp(prefix="chaos_elastic_")
+    coordinator = FleetReloadCoordinator(workdir, router)
+    controller = CapacityController(
+        router,
+        coordinator,
+        row_shape=(obs_dim,),
+        p95_target_ms=50.0,
+        min_requests=24,
+        drain_timeout_s=5.0,
+    )
+    # Three mixes cycling, so a round's plan always differs from the
+    # last COMMITTED one even when the round in between aborted (same
+    # mix two rounds apart would plans_equivalent-skip and starve the
+    # armed commit/retire cells).
+    mixes = (
+        ((1, 0.6), (4, 0.4)),
+        ((64, 0.5), (128, 0.5)),
+        ((8, 0.5), (16, 0.5)),
+    )
+    rng = np.random.default_rng(seed)
+    outcomes: List[dict] = []
+    steps: List[Tuple[float, int]] = []
+
+    def _drive_round(mix) -> None:
+        """One round of offered traffic; every accepted future must
+        resolve (collected for the no-lost-request invariant)."""
+        sizes = [s for s, _ in mix]
+        probs = [p for _, p in mix]
+        futures = []
+        for _ in range(requests_per_round):
+            n = int(rng.choice(sizes, p=probs))
+            obs = rng.standard_normal((n, obs_dim)).astype(np.float32)
+            try:
+                futures.append(router.submit(obs, timeout_s=5.0))
+            except Exception as e:  # noqa: BLE001 — typed reject
+                outcomes.append(
+                    {"ok": False, "hung": False, "error": type(e).__name__}
+                )
+            time.sleep(0.002)
+        for f in futures:
+            try:
+                result = f.result(timeout=15.0)
+            except FutureTimeout:
+                outcomes.append(
+                    {
+                        "ok": False,
+                        "hung": True,
+                        "error": "unresolved future",
+                    }
+                )
+                continue
+            except Exception as e:  # noqa: BLE001 — typed failure
+                outcomes.append(
+                    {"ok": False, "hung": False, "error": type(e).__name__}
+                )
+                continue
+            outcomes.append({"ok": True, "hung": False, "error": None})
+            steps.append((time.perf_counter(), int(result.model_step)))
+
+    from concurrent.futures import TimeoutError as FutureTimeout
+
+    prober = None
+    rounds_run = 0
+    try:
+        router.start()
+        warmup_fleet(router, (obs_dim,))
+        plane.arm(schedule)
+        plane.enabled = True
+        prober = _Prober(
+            router, obs_dim, interval_s=probe_interval_s
+        ).start()
+        # Scheduled rounds, then flush rounds until every armed fault
+        # fired (an aborted prewarm consumes no commit/retire cells, so
+        # the campaign keeps re-splitting until the schedule drains).
+        while rounds_run < rounds or (
+            plane.pending(ELASTIC_POINTS) > 0
+            and rounds_run < rounds + 6
+            and time.perf_counter() < deadline - 10
+        ):
+            mix = mixes[rounds_run % len(mixes)]
+            recorder.clear()  # each round decides from ITS mix alone
+            _drive_round(mix)
+            controller.step()
+            rounds_run += 1
+    finally:
+        # Never leave the process-global plane live past the campaign.
+        plane.enabled = False
+        if prober is not None:
+            prober.stop()
+        router.stop()
+
+    # ---- invariants ----------------------------------------------------
+    fired = plane.fired_record()
+    unfired = plane.pending()
+    violations += check_no_request_lost(outcomes + prober.outcomes)
+    violations += check_step_monotonic(
+        sorted(steps + prober.steps, key=lambda s: s[0])
+    )
+    compiles = {
+        f"replica{idx}_rung{bucket}": count
+        for idx, counts in router.compile_counts().items()
+        for bucket, count in counts.items()
+    }
+    violations += check_budget_one(compiles)
+    snap = controller.snapshot()
+    if snap["elastic_resplits_committed"] < 2:
+        violations.append(
+            Violation(
+                "campaign_coverage",
+                f"only {snap['elastic_resplits_committed']:.0f} "
+                "re-split(s) committed — the campaign never exercised "
+                "the commit seam under weather (raise rounds or lower "
+                "the fault count)",
+            )
+        )
+    if unfired:
+        violations.append(
+            Violation(
+                "campaign_coverage",
+                f"{unfired} armed fault(s) never fired — the campaign "
+                "ended before exercising its whole schedule (raise "
+                "rounds or lower the hit windows)",
+            )
+        )
+    report["chaos_violations"] = report_violations(violations, plane)
+    report["chaos_invariant_violations"] = len(violations)
+    report["chaos_faults_fired"] = len(fired)
+    report["chaos_faults_unfired"] = unfired
+    report["elastic_rounds"] = rounds_run
+    report["elastic_resplits_committed"] = int(
+        snap["elastic_resplits_committed"]
+    )
+    report["elastic_resplits_aborted"] = int(
+        snap["elastic_resplits_aborted"]
+    )
+    report["elastic_resplits_skipped"] = int(
+        snap["elastic_resplits_skipped"]
+    )
+    report["elastic_prewarm_compiles"] = int(
+        snap["elastic_prewarm_compiles_total"]
+    )
+    report["elastic_last_pause_ms"] = snap["elastic_last_pause_ms"]
+    report["requests_resolved"] = len(outcomes) + len(
+        prober.outcomes
+    )
+    report["requests_ok"] = sum(
+        1 for o in outcomes + prober.outcomes if o["ok"]
+    )
+    report["final_replicas"] = len(router.replicas)
+    report["campaign_seconds"] = round(time.perf_counter() - t_start, 2)
+    return report
+
+
 def run_mesh_campaign(
     seed: int = 0,
     faults: int = 20,
@@ -1260,6 +1539,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "on every consumed batch, budget-1 receipts per slice",
     )
     ap.add_argument(
+        "--elastic",
+        action="store_true",
+        help="point the storm at the elastic re-split seams "
+        "(serving/elastic): raises and delays at prewarm, at the "
+        "barrier commit, and at drain-retire while a CapacityController "
+        "re-splits a live fleet under alternating traffic mixes; "
+        "invariants: no accepted request lost, served steps monotone "
+        "through every commit, budget-1 compile receipts, >= 2 "
+        "committed re-splits, full schedule coverage",
+    )
+    ap.add_argument(
         "--print-schedule",
         action="store_true",
         help="emit the armed fault schedule (deterministic from the "
@@ -1272,6 +1562,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             ("--mesh", args.mesh),
             ("--train", args.train),
             ("--sebulba", args.sebulba),
+            ("--elastic", args.elastic),
         )
         if on
     ]
@@ -1279,6 +1570,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error(
             f"{' and '.join(exclusive)} are separate campaigns; pick one"
         )
+    if args.elastic:
+        elastic_faults = min(args.faults, 9)
+        if elastic_faults < args.faults:
+            print(
+                f"[storm] --elastic caps --faults at 9 (requested "
+                f"{args.faults}): the three re-split seams' armable "
+                "cells are bounded by the hit windows",
+                file=sys.stderr,
+            )
+        if args.print_schedule:
+            schedule = build_schedule(
+                args.seed, elastic_faults, point_names=ELASTIC_POINTS
+            )
+            print(json.dumps({
+                "chaos_seed": args.seed,
+                "chaos_faults_armed": len(schedule),
+                "schedule": schedule.record(),
+            }))
+            return 0
+        report = run_elastic_campaign(
+            seed=args.seed,
+            faults=elastic_faults,
+            budget_s=args.budget_s,
+        )
+        print(json.dumps(report))
+        return 0 if report.get("chaos_invariant_violations") == 0 else 1
     if args.sebulba:
         sebulba_faults = min(args.faults, 12)
         if sebulba_faults < args.faults:

@@ -900,6 +900,120 @@ def _tenancy_problems(rec: dict) -> list[str]:
     return problems
 
 
+def _elastic_problems(rec: dict) -> list[str]:
+    """Structural validation of the elastic-capacity fields
+    (serving/elastic, bench phase "elastic"), whenever present:
+
+    - ``serving_req_per_sec_at_p95_slo_elastic`` and ``..._static``
+      both finite numbers > 0 — the comparison is only evidence when
+      BOTH fleets actually sustained a rate at the p95 target on the
+      storm half;
+    - ``elastic_resplit_pause_ms`` a finite number in (0, 250]: the
+      barrier-commit pause is the WHOLE serving interruption a
+      re-split costs, and an unbounded (or zero — unmeasured) pause
+      means prewarm work leaked inside the gates;
+    - ``elastic_prewarm_compiles`` an int >= 1 (a re-split that
+      compiled nothing never built new rungs) recorded beside
+      ``elastic_storm_new_programs`` == 0 — the ledger census diff
+      proving every post-warm compile is attributed to prewarm, never
+      the measured request path;
+    - ``elastic_max_compiles_per_rung`` <= 1 (budget-1 receipts per
+      (arch, rung) after warm-up) and ``elastic_resplits_committed``
+      an int >= 1 wherever a pause was recorded.
+
+    ``"skipped"`` sentinels are honored as structurally absent."""
+    problems = []
+    for key in (
+        "serving_req_per_sec_at_p95_slo_elastic",
+        "serving_req_per_sec_at_p95_slo_static",
+    ):
+        v = _present(rec, key)
+        if v is None:
+            continue
+        try:
+            f = float(v)
+            if not math.isfinite(f) or f <= 0.0:
+                problems.append(
+                    f"{key}={v!r} (need a finite number > 0 — a fleet "
+                    "that sustained no rate at the p95 target was "
+                    "never actually measured on the storm half)"
+                )
+        except (TypeError, ValueError):
+            problems.append(f"{key} is not a number: {v!r}")
+    pause = _present(rec, "elastic_resplit_pause_ms")
+    if pause is not None:
+        try:
+            f = float(pause)
+            if not math.isfinite(f) or f <= 0.0 or f > 250.0:
+                problems.append(
+                    f"elastic_resplit_pause_ms={pause!r} (need a "
+                    "finite number in (0, 250]: the barrier-commit "
+                    "pause is the whole serving interruption — zero "
+                    "means unmeasured, above 250ms means prewarm or "
+                    "drain work leaked inside the closed gates)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"elastic_resplit_pause_ms is not a number: {pause!r}"
+            )
+        committed = _present(rec, "elastic_resplits_committed")
+        try:
+            if committed is None or int(float(committed)) < 1:
+                problems.append(
+                    "elastic_resplit_pause_ms recorded without "
+                    "elastic_resplits_committed >= 1 beside it (a "
+                    "pause nothing committed measured nothing)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                "elastic_resplits_committed is not an int: "
+                f"{committed!r}"
+            )
+    compiles = _present(rec, "elastic_prewarm_compiles")
+    if compiles is not None:
+        try:
+            if int(float(compiles)) < 1:
+                problems.append(
+                    f"elastic_prewarm_compiles={compiles!r} (a "
+                    "re-split that compiled nothing never built new "
+                    "rungs — the prewarm receipt is missing)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                f"elastic_prewarm_compiles is not an int: {compiles!r}"
+            )
+        storm_new = _present(rec, "elastic_storm_new_programs")
+        try:
+            if storm_new is None or int(float(storm_new)) != 0:
+                problems.append(
+                    f"elastic_storm_new_programs={storm_new!r} (need "
+                    "exactly 0 beside elastic_prewarm_compiles: the "
+                    "census diff must prove no program registered "
+                    "during the measured storm — every compile "
+                    "attributed to prewarm, never the request path)"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                "elastic_storm_new_programs is not an int: "
+                f"{storm_new!r}"
+            )
+    max_compiles = _present(rec, "elastic_max_compiles_per_rung")
+    if max_compiles is not None:
+        try:
+            if int(float(max_compiles)) > 1:
+                problems.append(
+                    f"elastic_max_compiles_per_rung={max_compiles!r} "
+                    "— a rung retraced after warm-up; budget-1 "
+                    "receipts are broken"
+                )
+        except (TypeError, ValueError):
+            problems.append(
+                "elastic_max_compiles_per_rung is not an int: "
+                f"{max_compiles!r}"
+            )
+    return problems
+
+
 def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     """Return the list of violations (empty = evidence-grade record)."""
     problems = []
@@ -925,6 +1039,7 @@ def check(rec: dict, require: list[str], expect: list[str]) -> list[str]:
     problems.extend(_sebulba_problems(rec))
     problems.extend(_envs_problems(rec))
     problems.extend(_tenancy_problems(rec))
+    problems.extend(_elastic_problems(rec))
     for field in require:
         if rec.get(field) == SKIPPED:
             problems.append(

@@ -49,6 +49,7 @@ import contextlib
 import json
 import os
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -408,6 +409,187 @@ def _run_slo_bench(args) -> int:
     return 0
 
 
+def _run_elastic_bench(args) -> int:
+    """The --elastic-bench comparison (bench.py phase "elastic"): a
+    shifting-mix day — interactive-heavy first half, big-rung storm
+    second half — against two fleets on the same forced multi-device
+    CPU mesh:
+
+    - **static**: split + ladder autotuned on the FIRST half and then
+      frozen — the fleet a pre-traffic tuner ships. The storm's
+      64–256-row requests chunk through its small top rung.
+    - **elastic**: boots identically, but a ``CapacityController``
+      watches the live ``TraceRecorder`` and re-splits at the fleet
+      batch barrier when the mix shifts (prewarm-then-commit; the
+      serving interruption is ``elastic_resplit_pause_ms``, the
+      barrier pause alone).
+
+    Both fleets are measured on the storm half with the same rate
+    bisection (``max_rate_at_slo``); budget-1 compile receipts and a
+    ledger census diff (no program registered during the measured
+    storm — every compile attributed to prewarm) ride the report.
+    One JSON line to stdout.
+    """
+    import numpy as np  # noqa: F401 — row dtype parity with _run_slo_bench
+
+    from marl_distributedformation_tpu.obs.ledger import get_ledger
+    from marl_distributedformation_tpu.serving import (
+        CapacityController,
+        TraceRecorder,
+        max_rate_at_slo,
+        run_load,
+        synthetic_trace,
+    )
+    from marl_distributedformation_tpu.serving.autotune import (
+        autotune_ladder,
+    )
+    from marl_distributedformation_tpu.serving.fleet import (
+        FleetReloadCoordinator,
+        FleetRouter,
+        warmup_fleet,
+    )
+
+    replicas = args.replicas or 2
+    _ensure_cpu_devices(replicas)
+    if not args.init_policy:
+        raise SystemExit("--elastic-bench wants --init-policy + --obs-dim")
+    policy = _build_init_policy(args)
+    row_shape = (args.obs_dim,)
+    duration = args.duration
+    interactive_mix = ((1, 0.5), (2, 0.2), (4, 0.2), (8, 0.1))
+    storm_mix = ((64, 0.35), (128, 0.3), (256, 0.35))
+    storm_rps = max(4.0, args.load_rps / 6.0)
+    interactive = synthetic_trace(
+        duration, args.load_rps, seed=7, size_mix=interactive_mix
+    )
+    storm = synthetic_trace(
+        duration, storm_rps, seed=9, size_mix=storm_mix
+    )
+
+    # The split a pre-traffic tuner ships: autotuned on the first half,
+    # then frozen. The storm never informs it.
+    first_half_plan = autotune_ladder(
+        interactive, p95_target_ms=args.slo_p95_ms
+    )
+    boot_buckets = first_half_plan.buckets
+    report = {
+        "replicas": replicas,
+        "slo_p95_target_ms": float(args.slo_p95_ms),
+        "boot_buckets": ",".join(str(b) for b in boot_buckets),
+        "interactive_rps": float(args.load_rps),
+        "storm_rps": float(storm_rps),
+    }
+
+    def _measure_storm(router, seed):
+        rep = run_load(router, storm, row_shape, seed=seed)
+        best, probes = max_rate_at_slo(
+            router,
+            row_shape,
+            p95_target_ms=args.slo_p95_ms,
+            lo_rps=storm_rps / 2,
+            hi_rps=storm_rps * 8,
+            probe_duration_s=min(1.0, duration),
+            iterations=args.slo_iterations,
+            seed=seed,
+            size_mix=storm_mix,
+            probe_retries=2,
+        )
+        return rep.p95_ms, best
+
+    with contextlib.ExitStack() as stack:
+        static = stack.enter_context(
+            FleetRouter(
+                policy,
+                num_replicas=replicas,
+                buckets=boot_buckets,
+                window_ms=first_half_plan.window_ms,
+                max_queue=args.queue,
+            )
+        )
+        recorder = TraceRecorder()
+        elastic = stack.enter_context(
+            FleetRouter(
+                policy,
+                num_replicas=replicas,
+                buckets=boot_buckets,
+                window_ms=first_half_plan.window_ms,
+                max_queue=args.queue,
+                trace_recorder=recorder,
+            )
+        )
+        warmup_fleet(static, row_shape)
+        warmup_fleet(elastic, row_shape)
+        with tempfile.TemporaryDirectory() as empty_dir:
+            coordinator = FleetReloadCoordinator(empty_dir, elastic)
+            controller = CapacityController(
+                elastic,
+                coordinator,
+                row_shape=row_shape,
+                p95_target_ms=args.slo_p95_ms,
+                min_requests=32,
+            )
+            # First half: both fleets serve the interactive mix (also
+            # the fresh-process settle replay, PR-6 bench discipline).
+            run_load(static, interactive, row_shape, seed=11)
+            rep_i = run_load(elastic, interactive, row_shape, seed=11)
+            report["elastic_interactive_p95_ms"] = rep_i.p95_ms
+            controller.step()  # may retune windows; interactive-earned
+            # The mix shifts: storm traffic reaches the elastic fleet,
+            # the controller re-splits, prewarm-then-commit. The static
+            # fleet serves the same storm on its frozen split.
+            run_load(elastic, storm, row_shape, seed=13)
+            resplit = controller.step()
+            if resplit is None or not resplit.get("committed"):
+                print(
+                    f"[serve] elastic bench: storm re-split did not "
+                    f"commit ({resplit}) — failing",
+                    file=sys.stderr,
+                )
+                return 1
+            # Measured storm: census diff proves no compile rides it.
+            programs_before = len(get_ledger().entries())
+            static_p95, static_rate = _measure_storm(static, seed=13)
+            elastic_p95, elastic_rate = _measure_storm(elastic, seed=13)
+            report["elastic_storm_new_programs"] = (
+                len(get_ledger().entries()) - programs_before
+            )
+            snap = controller.snapshot()
+            report["static_storm_p95_ms"] = static_p95
+            report["elastic_storm_p95_ms"] = elastic_p95
+            report["req_per_sec_at_p95_slo_static"] = static_rate
+            report["req_per_sec_at_p95_slo_elastic"] = elastic_rate
+            report["elastic_resplit_pause_ms"] = snap[
+                "elastic_last_pause_ms"
+            ]
+            report["elastic_resplits_committed"] = snap[
+                "elastic_resplits_committed"
+            ]
+            report["elastic_prewarm_compiles"] = snap[
+                "elastic_prewarm_compiles_total"
+            ]
+            report["elastic_buckets"] = ",".join(
+                str(b) for b in resplit["decision"]["replicated_buckets"]
+                + resplit["decision"]["sharded_buckets"]
+            )
+            max_compiles = 0
+            for router in (static, elastic):
+                for counts in router.compile_counts().values():
+                    if counts:
+                        max_compiles = max(
+                            max_compiles, *counts.values()
+                        )
+            report["max_compiles_per_rung"] = max_compiles
+    print(json.dumps(report), flush=True)
+    if report["req_per_sec_at_p95_slo_elastic"] <= 0:
+        print(
+            "[serve] elastic bench: elastic fleet sustained no rate at "
+            "the p95 target — failing",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def _run_fleet(args) -> int:
     """The --fleet serving path: router + coordinated reload +
     optional HTTP frontend (serving/fleet/, docs/serving.md "Fleet")."""
@@ -432,6 +614,11 @@ def _run_fleet(args) -> int:
             ),
             dtype="bfloat16" if args.bf16 else None,
         )
+    recorder = None
+    if args.record_trace:
+        from marl_distributedformation_tpu.serving import TraceRecorder
+
+        recorder = TraceRecorder()
     logger = None
     coordinator = None
     if args.init_policy:
@@ -443,6 +630,7 @@ def _run_fleet(args) -> int:
             window_ms=args.window_ms,
             max_queue=args.queue,
             sharded=sharded,
+            trace_recorder=recorder,
         )
     elif args.log_dir:
         from marl_distributedformation_tpu.utils.logging import MetricsLogger
@@ -459,6 +647,7 @@ def _run_fleet(args) -> int:
             poll_interval_s=args.poll_s,
             logger=logger,
             sharded=sharded,
+            trace_recorder=recorder,
         )
         policy = router.policy
         print(
@@ -536,6 +725,21 @@ def _run_fleet(args) -> int:
         router.stop()
         if logger is not None:
             logger.close()
+        if recorder is not None:
+            # Replayable loadgen JSONL (serving.loadgen.load_trace):
+            # feed it back through run_load or autotune_ladder.
+            if recorder.save(args.record_trace):
+                print(
+                    f"[serve] recorded {recorder.recorded_total} "
+                    f"arrivals -> {args.record_trace}",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    "[serve] --record-trace saw <2 arrivals; nothing "
+                    "to save",
+                    file=sys.stderr,
+                )
     return 0
 
 
@@ -631,9 +835,22 @@ def _run_tenants(args) -> int:
         ),
         file=sys.stderr,
     )
+    frontend = None
     try:
         fleet.start()
-        if args.smoke or not args.watch:
+        if args.port is not None:
+            # FleetFrontend duck-types over the TenantFleet: submits
+            # carry model_id, /v1/metrics reports per-lane gauges.
+            from marl_distributedformation_tpu.serving.fleet import (
+                FleetFrontend,
+            )
+
+            frontend = FleetFrontend(fleet, port=args.port).start()
+            print(
+                f"[serve] tenant frontend listening on {frontend.url}",
+                file=sys.stderr,
+            )
+        if args.smoke or (args.port is None and not args.watch):
             report = run_tenant_smoke(
                 fleet,
                 duration_s=args.duration,
@@ -679,6 +896,8 @@ def _run_tenants(args) -> int:
     except KeyboardInterrupt:
         print("[serve] interrupted; shutting down", file=sys.stderr)
     finally:
+        if frontend is not None:
+            frontend.stop()
         fleet.stop()
     return 0
 
@@ -802,6 +1021,23 @@ def main(argv=None) -> int:
         "replica count)",
     )
     parser.add_argument(
+        "--record-trace",
+        metavar="PATH",
+        help="with --fleet: record every offered request arrival "
+        "(rows + SLO class + inter-arrival gap, captured before "
+        "admission control) and dump replayable loadgen JSONL here on "
+        "shutdown — the same format synthetic_trace saves, so the "
+        "recorded day replays through run_load / autotune_ladder",
+    )
+    parser.add_argument(
+        "--elastic-bench",
+        action="store_true",
+        help="run the elastic-vs-static capacity bench (bench.py phase "
+        "'elastic'): a shifting-mix trace against a frozen "
+        "first-half-tuned fleet and a CapacityController-managed one, "
+        "both measured on the storm half; one JSON line",
+    )
+    parser.add_argument(
         "--slo-bench",
         action="store_true",
         help="run the SLO-driven serving bench (bench.py phase 9): "
@@ -856,9 +1092,18 @@ def main(argv=None) -> int:
 
     if args.slo_bench:
         return _run_slo_bench(args)
+    if args.elastic_bench:
+        return _run_elastic_bench(args)
 
     if (args.port is not None or args.replicas is not None) and not args.fleet:
         raise SystemExit("--port/--replicas require --fleet")
+    if args.record_trace and not args.fleet:
+        raise SystemExit("--record-trace requires --fleet")
+    if args.record_trace and args.tenants:
+        raise SystemExit(
+            "--record-trace records one fleet's offered stream; it "
+            "does not combine with --tenants yet"
+        )
     if (args.sharded or args.bf16) and not args.fleet:
         raise SystemExit("--sharded/--bf16 require --fleet")
     if args.bf16 and not args.sharded:
@@ -871,11 +1116,10 @@ def main(argv=None) -> int:
                 "--tenants names each lane's checkpoint dir itself; "
                 "drop the positional log_dir / --init-policy"
             )
-        if args.sharded or args.port is not None or args.scenario:
+        if args.sharded or args.scenario:
             raise SystemExit(
-                "--tenants does not combine with --sharded/--port/"
-                "--scenario yet (lanes + sharded big-rung is an open "
-                "item, and the HTTP frontend wraps one router)"
+                "--tenants does not combine with --sharded/--scenario "
+                "yet (lanes + sharded big-rung is an open item)"
             )
         return _run_tenants(args)
 

@@ -574,6 +574,66 @@ def test_check_bench_record_gates():
         },
         [], [],
     ) == []
+    # Elastic-capacity fields (serving/elastic, bench phase "elastic"),
+    # validated whenever present: both storm-half rates finite
+    # positive, the re-split pause bounded in (0, 250] ms beside a
+    # committed re-split, prewarm compiles >= 1 beside a zero census
+    # diff (every compile attributed to prewarm, never the request
+    # path), budget-1 receipts per rung.
+    elastic_ok = {
+        **clean,
+        "serving_req_per_sec_at_p95_slo_elastic": 1440.0,
+        "serving_req_per_sec_at_p95_slo_static": 141.2,
+        "elastic_resplit_pause_ms": 0.049,
+        "elastic_resplits_committed": 2,
+        "elastic_prewarm_compiles": 7,
+        "elastic_storm_new_programs": 0,
+        "elastic_max_compiles_per_rung": 1,
+    }
+    assert check(elastic_ok, [], []) == []
+    assert check(
+        {**elastic_ok, "serving_req_per_sec_at_p95_slo_elastic": 0.0},
+        [], [],
+    )
+    assert check(
+        {
+            **elastic_ok,
+            "serving_req_per_sec_at_p95_slo_static": float("nan"),
+        },
+        [], [],
+    )
+    assert check(
+        {**elastic_ok, "serving_req_per_sec_at_p95_slo_elastic": "fast"},
+        [], [],
+    )
+    assert check({**elastic_ok, "elastic_resplit_pause_ms": 0.0}, [], [])
+    assert check(
+        {**elastic_ok, "elastic_resplit_pause_ms": 900.0}, [], []
+    )
+    assert check(
+        {**elastic_ok, "elastic_resplit_pause_ms": "quick"}, [], []
+    )
+    assert check(  # pause with nothing committed beside it
+        {**elastic_ok, "elastic_resplits_committed": 0}, [], []
+    )
+    assert check({**elastic_ok, "elastic_prewarm_compiles": 0}, [], [])
+    assert check(  # a compile leaked onto the measured storm path
+        {**elastic_ok, "elastic_storm_new_programs": 3}, [], []
+    )
+    assert check(  # a rung retraced after warm-up
+        {**elastic_ok, "elastic_max_compiles_per_rung": 2}, [], []
+    )
+    # Skipped sentinels honored across the elastic fields.
+    assert check(
+        {
+            **clean,
+            "serving_req_per_sec_at_p95_slo_elastic": "skipped",
+            "serving_req_per_sec_at_p95_slo_static": "skipped",
+            "elastic_resplit_pause_ms": "skipped",
+            "elastic_prewarm_compiles": "skipped",
+        },
+        [], [],
+    ) == []
 
 
 def test_partial_mirror_names_dodge_replay_glob():

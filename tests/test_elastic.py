@@ -1,0 +1,273 @@
+"""Elastic capacity contract (tier-1, multi-device CPU): the live
+control loop (serving/elastic) re-splits a serving fleet under a
+mixed-size storm without dropping requests, without breaking step
+monotonicity, and without a single compile riding the request path.
+
+The acceptance pins from the elastic ISSUE live here:
+
+- a re-split committed under live mixed-size traffic loses ZERO
+  accepted requests and serves globally monotonic ``model_step``s
+  across the membership swap;
+- retired replicas are drained THEN stopped (de-routed at the barrier,
+  emptied off-path) — the apply report and the schedulers agree;
+- a ledger census diff proves every compile after the fleet's warmup
+  is attributed to a prewarm round, never to serving traffic, and the
+  budget-1 per-rung receipts hold on the final replica set;
+- the hysteresis gate skips a plan equivalent to the one serving, a
+  thin window decides nothing, a headroom refusal and an injected
+  prewarm fault both abort the round with the old split untouched.
+"""
+
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marl_distributedformation_tpu.chaos import (  # noqa: E402
+    FaultSchedule,
+    FaultSpec,
+    get_fault_plane,
+)
+from marl_distributedformation_tpu.compat.policy import (  # noqa: E402
+    LoadedPolicy,
+)
+from marl_distributedformation_tpu.models import MLPActorCritic  # noqa: E402
+from marl_distributedformation_tpu.obs.ledger import get_ledger  # noqa: E402
+from marl_distributedformation_tpu.serving import (  # noqa: E402
+    CapacityController,
+    TraceRecorder,
+)
+from marl_distributedformation_tpu.serving.fleet import (  # noqa: E402
+    FleetReloadCoordinator,
+    FleetRouter,
+    warmup_fleet,
+)
+
+OBS_DIM = 6
+
+
+def _make_policy(seed=0):
+    model = MLPActorCritic(act_dim=2, hidden=(8, 8))
+    variables = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, OBS_DIM))
+    )
+    return LoadedPolicy(dict(variables), model_kwargs={"hidden": (8, 8)})
+
+
+def _obs(n, seed=0):
+    return (
+        np.random.default_rng(seed)
+        .standard_normal((n, OBS_DIM))
+        .astype(np.float32)
+    )
+
+
+def _elastic_fleet(tmp_path, min_requests=16):
+    """A 2-replica fleet on 2 devices with the recorder wired, warm,
+    plus its coordinator and controller — the storm fixture."""
+    recorder = TraceRecorder()
+    router = FleetRouter(
+        _make_policy(),
+        devices=jax.local_devices()[:2],
+        buckets=(1, 8),
+        window_ms=0.0,
+        trace_recorder=recorder,
+    )
+    router.start()
+    warmup_fleet(router, (OBS_DIM,))
+    coordinator = FleetReloadCoordinator(str(tmp_path), router)
+    controller = CapacityController(
+        router,
+        coordinator,
+        row_shape=(OBS_DIM,),
+        p95_target_ms=50.0,
+        min_requests=min_requests,
+        drain_timeout_s=5.0,
+    )
+    recorder.clear()  # warmup traffic is not a capacity signal
+    return recorder, router, controller
+
+
+def _drive(router, sizes, outcomes, steps, seed=0):
+    """Submit one request per size; every accepted future must resolve
+    (the no-lost-request pin) and successes record (t_done, step)."""
+    futures = []
+    for i, n in enumerate(sizes):
+        futures.append(router.submit(_obs(n, seed=seed + i), timeout_s=5.0))
+    for f in futures:
+        try:
+            result = f.result(timeout=15.0)
+        except FutureTimeout:
+            outcomes.append("hung")
+            continue
+        except Exception as e:  # noqa: BLE001 — typed failure = resolved
+            outcomes.append(type(e).__name__)
+            continue
+        outcomes.append("ok")
+        steps.append((time.perf_counter(), int(result.model_step)))
+
+
+def test_resplit_under_mixed_storm(tmp_path):
+    recorder, router, controller = _elastic_fleet(tmp_path)
+    ledger = get_ledger()
+    outcomes, steps = [], []
+    try:
+        # Big-rung traffic the boot ladder (1, 8) never planned for:
+        # fills the recorder past the decision floor.
+        _drive(router, [32, 64, 48, 32, 64, 16] * 3, outcomes, steps)
+        boot_indices = {r.index for r in router.replicas}
+
+        # Re-split WHILE the storm keeps arriving: a pump thread keeps
+        # requests in flight across prewarm, the barrier commit, and
+        # the drains.
+        stop = threading.Event()
+
+        def _pump():
+            batch = 0
+            while not stop.is_set():
+                _drive(
+                    router, [32, 8, 64, 1], outcomes, steps,
+                    seed=100 + batch,
+                )
+                batch += 1
+
+        pump = threading.Thread(target=_pump, daemon=True)
+        pump.start()
+        try:
+            report = controller.step()
+        finally:
+            stop.set()
+            pump.join(timeout=30.0)
+        assert report is not None and report["committed"], report
+
+        # Zero lost accepted requests across the swap.
+        assert "hung" not in outcomes, outcomes
+        assert outcomes and all(o == "ok" for o in outcomes), outcomes
+
+        # Globally monotonic served steps through the commit.
+        ordered = [s for _, s in sorted(steps, key=lambda x: x[0])]
+        assert all(
+            b >= a for a, b in zip(ordered, ordered[1:])
+        ), ordered
+
+        # Drained THEN retired: the report counted every boot replica
+        # drained clean, and their schedulers are stopped and empty.
+        assert report["retired_total"] == len(boot_indices)
+        assert report["drained_clean"] == report["retired_total"], report
+        live = {r.index for r in router.replicas}
+        assert live.isdisjoint(boot_indices), (live, boot_indices)
+
+        # The new ladder actually answers the storm: some live replica
+        # owns a rung (or sharded slice) >= the big request sizes.
+        top_rung = max(
+            max(r.engine.buckets) for r in router.replicas
+        )
+        assert top_rung >= 32, [
+            tuple(r.engine.buckets) for r in router.replicas
+        ]
+
+        # Census diff: prewarm accounted for every new ledger entry,
+        # and serving the storm after the commit compiled NOTHING.
+        assert report["prewarm_compiles"] >= 1, report
+        assert len(ledger.entries()) == report["prewarm_programs_after"]
+        post_outcomes, post_steps = [], []
+        _drive(
+            router, [64, 32, 8, 1, 48], post_outcomes, post_steps,
+            seed=999,
+        )
+        assert all(o == "ok" for o in post_outcomes), post_outcomes
+        assert len(ledger.entries()) == report["prewarm_programs_after"]
+        for counts in router.compile_counts().values():
+            assert all(c <= 1 for c in counts.values()), (
+                router.compile_counts()
+            )
+
+        # Hysteresis: an identical window replayed against the split
+        # it just earned is not a decision. (The first commit's plan
+        # included the pump's interleaved small requests, so align
+        # ``_current_plan`` with the pure mix first — that round may
+        # legitimately commit — then replay the SAME mix and require
+        # the skip.)
+        recorder.clear()
+        _drive(router, [32, 64, 48, 32, 64, 16] * 3, [], [])
+        controller.step()
+        recorder.clear()
+        more = []
+        _drive(router, [32, 64, 48, 32, 64, 16] * 3, more, [])
+        assert all(o == "ok" for o in more), more
+        skipped_before = controller.snapshot()["elastic_resplits_skipped"]
+        assert controller.step() is None
+        assert (
+            controller.snapshot()["elastic_resplits_skipped"]
+            == skipped_before + 1
+        )
+    finally:
+        router.stop()
+
+
+def test_thin_window_decides_nothing(tmp_path):
+    recorder, router, controller = _elastic_fleet(
+        tmp_path, min_requests=16
+    )
+    try:
+        outcomes, steps = [], []
+        _drive(router, [4, 8, 2], outcomes, steps)
+        assert all(o == "ok" for o in outcomes)
+        assert len(recorder) < controller.min_requests
+        assert controller.step() is None
+        assert (
+            controller.snapshot()["elastic_resplits_committed"] == 0
+        )
+    finally:
+        router.stop()
+
+
+def test_headroom_refusal_keeps_old_split(tmp_path):
+    recorder, router, controller = _elastic_fleet(tmp_path)
+    controller.headroom_bytes = 1.0  # nothing fits next to the fleet
+    try:
+        _drive(router, [32, 64] * 10, [], [])
+        decision = controller.decide()
+        assert decision is not None
+        report = controller.apply(decision)
+        assert report["skipped"] == "headroom"
+        assert not report["committed"]
+        # The old split still serves.
+        outcomes = []
+        _drive(router, [8, 1], outcomes, [])
+        assert all(o == "ok" for o in outcomes)
+    finally:
+        router.stop()
+
+
+def test_prewarm_fault_aborts_round_old_split_serves(tmp_path):
+    recorder, router, controller = _elastic_fleet(tmp_path)
+    plane = get_fault_plane()
+    plane.reset()
+    try:
+        _drive(router, [32, 64] * 10, [], [])
+        plane.arm(
+            FaultSchedule([FaultSpec("elastic.prewarm", "raise", 1)])
+        )
+        plane.enabled = True
+        report = controller.step()
+        assert report is not None and not report["committed"], report
+        assert "prewarm aborted" in report.get("error", ""), report
+        assert (
+            controller.snapshot()["elastic_resplits_aborted"] == 1.0
+        )
+        # Old split intact and serving; no half-built replica routed.
+        outcomes = []
+        _drive(router, [8, 1, 32], outcomes, [])
+        assert all(o == "ok" for o in outcomes), outcomes
+        assert all(
+            tuple(r.engine.buckets) == (1, 8) for r in router.replicas
+        )
+    finally:
+        plane.enabled = False
+        plane.reset()
+        router.stop()

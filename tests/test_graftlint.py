@@ -1802,6 +1802,23 @@ def test_package_scan_covers_tenancy():
     )
 
 
+def test_package_scan_covers_elastic():
+    """The zero-violation pin must include serving/elastic/ — the
+    capacity controller mutates router topology from a background
+    thread under the same locks the fleet's client threads take,
+    exactly the cross-thread shapes the lock-discipline rules police;
+    an exclude entry or package move cannot silently drop it from the
+    scan."""
+    from marl_distributedformation_tpu.analysis import load_config
+    from marl_distributedformation_tpu.analysis.linter import iter_python_files
+
+    files = list(iter_python_files([PACKAGE], load_config(REPO), root=REPO))
+    elastic = {f.name for f in files if "elastic" in f.parts}
+    assert {"__init__.py", "controller.py"} <= elastic, (
+        f"serving/elastic/ missing from the lint scan: {elastic}"
+    )
+
+
 def test_package_scan_covers_train_modules():
     """The zero-violation pin must include every train/ module (the
     fused-scan trainer is the hottest scan in the repo — exactly where
